@@ -1,0 +1,909 @@
+"""BLS12-381 pairing-friendly curve — pure-Python reference implementation.
+
+This is the *oracle* backend for lachain-tpu's threshold cryptography. It is
+deliberately written for clarity and verifiability, not speed: the fast paths
+are (a) the native C++ backend (lachain_tpu/crypto/native) and (b) the batched
+JAX kernels (lachain_tpu/ops). Both are conformance-tested against this module.
+
+Role parity with the reference implementation (see /root/reference):
+  - MCL.BLS12_381.Net `Fr`, `G1`, `G2`, `GT`, `GT.Pairing`, `G2.SetHashOf`
+    used by src/Lachain.Crypto/TPKE/PublicKey.cs and
+    src/Lachain.Crypto/ThresholdSignature/PublicKeySet.cs.
+  - `MclBls12381.EvaluatePolynomial` / `LagrangeInterpolate`
+    (src/Lachain.Crypto/MclBls12381.cs) -> `fr_eval_poly` / `fr_lagrange_at_0`
+    plus the group-element interpolation helpers here.
+
+Design notes
+------------
+* Field elements are plain ints (Fp, Fr) or tuples of ints (Fp2/Fp6/Fp12);
+  tuples + module-level functions are the fastest idiomatic pure-Python form.
+* All derived constants (cofactors, Frobenius coefficients, final-exponent
+  digits) are COMPUTED at import from the curve parameter X_PARAM and asserted,
+  so there are no hand-transcribed magic numbers beyond p, r, the generators
+  and X_PARAM itself (each validated by on-curve / identity asserts below).
+* The pairing is the optimal ate pairing computed on the untwisted curve
+  E(Fp12) with textbook affine line functions: slowest possible, easiest to
+  audit. `multi_pairing` shares the final exponentiation.
+* Subgroup membership: G1/G2 deserialization checks r*P == inf.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+# BLS parameter ("x" / "z" in the literature). Everything else derives from it.
+X_PARAM = -0xD201000000010000
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# Sanity: p and r follow the BLS12 family formulas.
+assert R == X_PARAM**4 - X_PARAM**2 + 1
+assert (X_PARAM - 1) ** 2 % 3 == 0
+assert P == (X_PARAM - 1) ** 2 * (X_PARAM**4 - X_PARAM**2 + 1) // 3 + X_PARAM
+assert P % 6 == 1
+
+B_G1 = 4  # E : y^2 = x^3 + 4 over Fp
+# E': y^2 = x^3 + 4*(1+u) over Fp2 (M-twist), xi = 1 + u
+XI = (1, 1)
+
+# Trace of Frobenius over Fp: #E(Fp) = p + 1 - t, t = x + 1 for BLS12.
+TRACE = X_PARAM + 1
+N_G1 = P + 1 - TRACE
+assert N_G1 % R == 0
+H_G1 = N_G1 // R  # G1 cofactor
+
+# Curve order over Fp2 and the sextic-twist order (self-derived, see SURVEY.md
+# §7 "hard parts": avoids transcribing the 508-bit G2 cofactor by hand).
+_T2 = TRACE * TRACE - 2 * P  # trace over Fp2
+_FSQ = (4 * P * P - _T2 * _T2) // 3
+_F = math.isqrt(_FSQ)
+assert _F * _F == _FSQ
+# The two sextic twists have orders p^2 + 1 - (+-3f + t2)/2; pick the r-divisible one.
+_cand1 = P * P + 1 - (3 * _F + _T2) // 2
+_cand2 = P * P + 1 - (-3 * _F + _T2) // 2
+if _cand1 % R == 0:
+    N_G2 = _cand1
+else:
+    assert _cand2 % R == 0
+    N_G2 = _cand2
+H_G2 = N_G2 // R  # G2 cofactor
+
+# ---------------------------------------------------------------------------
+# Fp — arithmetic mod p on plain ints
+# ---------------------------------------------------------------------------
+
+
+def fp_inv(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+def fp_sqrt(a: int) -> Optional[int]:
+    """Square root in Fp (p ≡ 3 mod 4), or None if a is not a QR."""
+    s = pow(a, (P + 1) // 4, P)
+    return s if s * s % P == a % P else None
+
+
+# ---------------------------------------------------------------------------
+# Fp2 = Fp[u]/(u^2+1) — elements are (a0, a1) meaning a0 + a1*u
+# ---------------------------------------------------------------------------
+
+FP2_ZERO = (0, 0)
+FP2_ONE = (1, 0)
+
+
+def fp2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fp2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fp2_neg(a):
+    return (-a[0] % P, -a[1] % P)
+
+
+def fp2_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = a0 * b0
+    t1 = a1 * b1
+    t2 = (a0 + a1) * (b0 + b1)
+    return ((t0 - t1) % P, (t2 - t0 - t1) % P)
+
+
+def fp2_sqr(a):
+    a0, a1 = a
+    t = a0 * a1
+    return ((a0 + a1) * (a0 - a1) % P, (t + t) % P)
+
+
+def fp2_muls(a, s: int):
+    return (a[0] * s % P, a[1] * s % P)
+
+
+def fp2_conj(a):
+    return (a[0], -a[1] % P)
+
+
+def fp2_inv(a):
+    a0, a1 = a
+    norm = (a0 * a0 + a1 * a1) % P
+    ninv = fp_inv(norm)
+    return (a0 * ninv % P, -a1 * ninv % P)
+
+
+def fp2_pow(a, e: int):
+    result = FP2_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fp2_mul(result, base)
+        base = fp2_sqr(base)
+        e >>= 1
+    return result
+
+
+def fp2_sqrt(a) -> Optional[Tuple[int, int]]:
+    """Square root in Fp2 via the norm trick; None if not a QR."""
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        s = fp_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        # a0 = -b^2  =>  sqrt = b*u
+        t = fp_sqrt(-a0 % P)
+        if t is not None:
+            return (0, t)
+        return None
+    n = (a0 * a0 + a1 * a1) % P
+    s = fp_sqrt(n)
+    if s is None:
+        return None
+    inv2 = fp_inv(2)
+    t = (a0 + s) * inv2 % P
+    lam = fp_sqrt(t)
+    if lam is None:
+        t = (a0 - s) * inv2 % P
+        lam = fp_sqrt(t)
+        if lam is None:
+            return None
+    y0 = lam
+    y1 = a1 * fp_inv((2 * lam) % P) % P
+    res = (y0, y1)
+    return res if fp2_sqr(res) == (a0, a1) else None
+
+
+# ---------------------------------------------------------------------------
+# Fp6 = Fp2[v]/(v^3 - xi) — elements are (c0, c1, c2), each in Fp2
+# ---------------------------------------------------------------------------
+
+FP6_ZERO = (FP2_ZERO, FP2_ZERO, FP2_ZERO)
+FP6_ONE = (FP2_ONE, FP2_ZERO, FP2_ZERO)
+
+
+def _mul_xi(a):  # a * (1 + u)
+    a0, a1 = a
+    return ((a0 - a1) % P, (a0 + a1) % P)
+
+
+def fp6_add(a, b):
+    return (fp2_add(a[0], b[0]), fp2_add(a[1], b[1]), fp2_add(a[2], b[2]))
+
+
+def fp6_sub(a, b):
+    return (fp2_sub(a[0], b[0]), fp2_sub(a[1], b[1]), fp2_sub(a[2], b[2]))
+
+
+def fp6_neg(a):
+    return (fp2_neg(a[0]), fp2_neg(a[1]), fp2_neg(a[2]))
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t00 = fp2_mul(a0, b0)
+    t11 = fp2_mul(a1, b1)
+    t22 = fp2_mul(a2, b2)
+    c0 = fp2_add(t00, _mul_xi(fp2_add(fp2_mul(a1, b2), fp2_mul(a2, b1))))
+    c1 = fp2_add(fp2_add(fp2_mul(a0, b1), fp2_mul(a1, b0)), _mul_xi(t22))
+    c2 = fp2_add(fp2_add(fp2_mul(a0, b2), fp2_mul(a2, b0)), t11)
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):  # a * v  (shift with v^3 = xi)
+    return (_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    t0 = fp2_sub(fp2_sqr(a0), _mul_xi(fp2_mul(a1, a2)))
+    t1 = fp2_sub(_mul_xi(fp2_sqr(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    f = fp2_add(
+        fp2_mul(a0, t0),
+        _mul_xi(fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))),
+    )
+    finv = fp2_inv(f)
+    return (fp2_mul(t0, finv), fp2_mul(t1, finv), fp2_mul(t2, finv))
+
+
+# ---------------------------------------------------------------------------
+# Fp12 = Fp6[w]/(w^2 - v) — elements are (c0, c1), each in Fp6
+# ---------------------------------------------------------------------------
+
+FP12_ONE = (FP6_ONE, FP6_ZERO)
+FP12_ZERO = (FP6_ZERO, FP6_ZERO)
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_sub(a, b):
+    return (fp6_sub(a[0], b[0]), fp6_sub(a[1], b[1]))
+
+
+def fp12_neg(a):
+    return (fp6_neg(a[0]), fp6_neg(a[1]))
+
+
+def fp12_conj(a):  # Frobenius^6: w -> -w
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    f = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    finv = fp6_inv(f)
+    return (fp6_mul(a0, finv), fp6_neg(fp6_mul(a1, finv)))
+
+
+def fp12_pow(a, e: int):
+    if e < 0:
+        return fp12_pow(fp12_inv(a), -e)
+    result = FP12_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fp12_mul(result, base)
+        base = fp12_sqr(base)
+        e >>= 1
+    return result
+
+
+def fp12_eq_one(a) -> bool:
+    return a == FP12_ONE
+
+
+# Frobenius coefficients gamma_i = xi^((p-1)*i/6), i = 1..5 (computed, not
+# transcribed — mirrors how MCL bakes them in at build time).
+_GAMMA = [FP2_ONE] + [fp2_pow(XI, (P - 1) * i // 6) for i in range(1, 6)]
+
+
+def fp12_frobenius(a):
+    """a^p on Fp12 in the 2-over-3 tower basis {1, v, v^2, w, vw, v^2 w}."""
+    (a00, a01, a02), (a10, a11, a12) = a
+    c00 = fp2_conj(a00)
+    c01 = fp2_mul(fp2_conj(a01), _GAMMA[2])
+    c02 = fp2_mul(fp2_conj(a02), _GAMMA[4])
+    c10 = fp2_mul(fp2_conj(a10), _GAMMA[1])
+    c11 = fp2_mul(fp2_conj(a11), _GAMMA[3])
+    c12 = fp2_mul(fp2_conj(a12), _GAMMA[5])
+    return ((c00, c01, c02), (c10, c11, c12))
+
+
+def fp12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fp12_frobenius(a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Elliptic-curve point ops.
+# G1: E(Fp),  Jacobian tuples (X, Y, Z) of ints;  Z == 0 means infinity.
+# G2: E'(Fp2), Jacobian tuples (X, Y, Z) of Fp2;   Z == (0,0) means infinity.
+# ---------------------------------------------------------------------------
+
+G1_INF = (0, 1, 0)
+G2_INF = (FP2_ZERO, FP2_ONE, FP2_ZERO)
+
+G1_GEN = (
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB,
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1,
+    1,
+)
+G2_GEN = (
+    (
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ),
+    (
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ),
+    FP2_ONE,
+)
+
+
+def g1_is_inf(pt) -> bool:
+    return pt[2] % P == 0
+
+
+def g1_dbl(pt):
+    X1, Y1, Z1 = pt
+    if Z1 % P == 0 or Y1 % P == 0:
+        return G1_INF
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = B * B % P
+    D = 2 * ((X1 + B) * (X1 + B) - A - C) % P
+    E = 3 * A % P
+    F = E * E % P
+    X3 = (F - 2 * D) % P
+    Y3 = (E * (D - X3) - 8 * C) % P
+    Z3 = 2 * Y1 * Z1 % P
+    return (X3, Y3, Z3)
+
+
+def g1_add(p1, p2):
+    if p1[2] % P == 0:
+        return p2
+    if p2[2] % P == 0:
+        return p1
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    if U1 == U2:
+        if S1 == S2:
+            return g1_dbl(p1)
+        return G1_INF
+    H = (U2 - U1) % P
+    I = 4 * H * H % P
+    J = H * I % P
+    rr = 2 * (S2 - S1) % P
+    V = U1 * I % P
+    X3 = (rr * rr - J - 2 * V) % P
+    Y3 = (rr * (V - X3) - 2 * S1 * J) % P
+    Z3 = 2 * H * Z1 * Z2 % P
+    return (X3, Y3, Z3)
+
+
+def g1_neg(pt):
+    return (pt[0], -pt[1] % P, pt[2])
+
+
+def g1_mul(pt, k: int):
+    k %= N_G1
+    result = G1_INF
+    addend = pt
+    while k:
+        if k & 1:
+            result = g1_add(result, addend)
+        addend = g1_dbl(addend)
+        k >>= 1
+    return result
+
+
+def g1_to_affine(pt):
+    X, Y, Z = pt
+    if Z % P == 0:
+        return None  # infinity
+    zinv = fp_inv(Z % P)
+    z2 = zinv * zinv % P
+    return (X * z2 % P, Y * z2 * zinv % P)
+
+
+def g1_from_affine(aff):
+    if aff is None:
+        return G1_INF
+    return (aff[0] % P, aff[1] % P, 1)
+
+
+def g1_eq(a, b) -> bool:
+    if g1_is_inf(a) or g1_is_inf(b):
+        return g1_is_inf(a) and g1_is_inf(b)
+    return g1_to_affine(a) == g1_to_affine(b)
+
+
+def g1_is_on_curve(pt) -> bool:
+    if g1_is_inf(pt):
+        return True
+    aff = g1_to_affine(pt)
+    x, y = aff
+    return (y * y - (x * x * x + B_G1)) % P == 0
+
+
+def g2_is_inf(pt) -> bool:
+    return pt[2][0] % P == 0 and pt[2][1] % P == 0
+
+
+def g2_dbl(pt):
+    X1, Y1, Z1 = pt
+    if g2_is_inf(pt) or Y1 == FP2_ZERO:
+        return G2_INF
+    A = fp2_sqr(X1)
+    B = fp2_sqr(Y1)
+    C = fp2_sqr(B)
+    D = fp2_muls(fp2_sub(fp2_sub(fp2_sqr(fp2_add(X1, B)), A), C), 2)
+    E = fp2_muls(A, 3)
+    F = fp2_sqr(E)
+    X3 = fp2_sub(F, fp2_muls(D, 2))
+    Y3 = fp2_sub(fp2_mul(E, fp2_sub(D, X3)), fp2_muls(C, 8))
+    Z3 = fp2_muls(fp2_mul(Y1, Z1), 2)
+    return (X3, Y3, Z3)
+
+
+def g2_add(p1, p2):
+    if g2_is_inf(p1):
+        return p2
+    if g2_is_inf(p2):
+        return p1
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = fp2_sqr(Z1)
+    Z2Z2 = fp2_sqr(Z2)
+    U1 = fp2_mul(X1, Z2Z2)
+    U2 = fp2_mul(X2, Z1Z1)
+    S1 = fp2_mul(fp2_mul(Y1, Z2), Z2Z2)
+    S2 = fp2_mul(fp2_mul(Y2, Z1), Z1Z1)
+    if U1 == U2:
+        if S1 == S2:
+            return g2_dbl(p1)
+        return G2_INF
+    H = fp2_sub(U2, U1)
+    I = fp2_muls(fp2_sqr(H), 4)
+    J = fp2_mul(H, I)
+    rr = fp2_muls(fp2_sub(S2, S1), 2)
+    V = fp2_mul(U1, I)
+    X3 = fp2_sub(fp2_sub(fp2_sqr(rr), J), fp2_muls(V, 2))
+    Y3 = fp2_sub(fp2_mul(rr, fp2_sub(V, X3)), fp2_muls(fp2_mul(S1, J), 2))
+    Z3 = fp2_muls(fp2_mul(fp2_mul(H, Z1), Z2), 2)
+    return (X3, Y3, Z3)
+
+
+def g2_neg(pt):
+    return (pt[0], fp2_neg(pt[1]), pt[2])
+
+
+def g2_mul(pt, k: int):
+    if k < 0:
+        return g2_mul(g2_neg(pt), -k)
+    result = G2_INF
+    addend = pt
+    while k:
+        if k & 1:
+            result = g2_add(result, addend)
+        addend = g2_dbl(addend)
+        k >>= 1
+    return result
+
+
+def g2_to_affine(pt):
+    X, Y, Z = pt
+    if g2_is_inf(pt):
+        return None
+    zinv = fp2_inv(Z)
+    z2 = fp2_sqr(zinv)
+    return (fp2_mul(X, z2), fp2_mul(fp2_mul(Y, z2), zinv))
+
+
+def g2_from_affine(aff):
+    if aff is None:
+        return G2_INF
+    return (aff[0], aff[1], FP2_ONE)
+
+
+def g2_eq(a, b) -> bool:
+    if g2_is_inf(a) or g2_is_inf(b):
+        return g2_is_inf(a) and g2_is_inf(b)
+    return g2_to_affine(a) == g2_to_affine(b)
+
+
+def g2_is_on_curve(pt) -> bool:
+    if g2_is_inf(pt):
+        return True
+    x, y = g2_to_affine(pt)
+    b = fp2_muls(XI, B_G1)
+    return fp2_sub(fp2_sqr(y), fp2_add(fp2_mul(fp2_sqr(x), x), b)) == FP2_ZERO
+
+
+assert g1_is_on_curve(G1_GEN)
+assert g2_is_on_curve(G2_GEN)
+assert g1_is_inf(g1_mul(G1_GEN, R))
+assert g2_is_inf(g2_mul(G2_GEN, R))
+
+
+def g1_in_subgroup(pt) -> bool:
+    return g1_is_on_curve(pt) and g1_is_inf(g1_mul(pt, R))
+
+
+def g2_in_subgroup(pt) -> bool:
+    return g2_is_on_curve(pt) and g2_is_inf(g2_mul(pt, R))
+
+
+# ---------------------------------------------------------------------------
+# Pairing — optimal ate on the untwisted curve E(Fp12), affine line functions.
+# Mirrors the role of GT.Pairing in the reference (MCL binding); the formulas
+# are the textbook ones so this module can serve as the conformance oracle.
+# ---------------------------------------------------------------------------
+
+# Untwist: psi(x, y) = (x / w^2, y / w^3), w^6 = xi.  Elements of E(Fp12) are
+# affine pairs of Fp12 or None for infinity.
+
+# 1/w^2 = w^10 / xi  and 1/w^3 = w^9 / xi in Fp12... computed directly instead:
+# w^2 = v (Fp6 element 0 + 1*v + 0*v^2 embedded in c0), w^3 = v*w.
+_W2 = ((FP2_ZERO, FP2_ONE, FP2_ZERO), FP6_ZERO)  # w^2 = v
+_W3 = (FP6_ZERO, (FP2_ZERO, FP2_ONE, FP2_ZERO))  # w^3 = v*w
+_W2_INV = fp12_inv(_W2)
+_W3_INV = fp12_inv(_W3)
+
+
+def _fp2_to_fp12(a):
+    return ((a, FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+def _fp_to_fp12(a: int):
+    return (((a % P, 0), FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+def _untwist(q2_affine):
+    """Map an affine G2 (twist) point into E(Fp12) affine coordinates."""
+    if q2_affine is None:
+        return None
+    x, y = q2_affine
+    return (
+        fp12_mul(_fp2_to_fp12(x), _W2_INV),
+        fp12_mul(_fp2_to_fp12(y), _W3_INV),
+    )
+
+
+def _e12_add(p1, p2):
+    """Affine addition on E(Fp12): y^2 = x^3 + 4."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            # doubling
+            if y1 == FP12_ZERO:
+                return None
+            lam = fp12_mul(
+                fp12_mul(fp12_sqr(x1), _fp_to_fp12(3)),
+                fp12_inv(fp12_mul(y1, _fp_to_fp12(2))),
+            )
+        else:
+            return None
+    else:
+        lam = fp12_mul(fp12_sub(y2, y1), fp12_inv(fp12_sub(x2, x1)))
+    x3 = fp12_sub(fp12_sub(fp12_sqr(lam), x1), x2)
+    y3 = fp12_sub(fp12_mul(lam, fp12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _line(t, q, pxy):
+    """Evaluate the line through t and q (affine E(Fp12)) at P=(px,py) in Fp."""
+    px, py = pxy
+    x1, y1 = t
+    if q is not None and t is not None and x1 == q[0] and y1 != q[1]:
+        # vertical line
+        return fp12_sub(_fp_to_fp12(px), x1)
+    if t == q:
+        if y1 == FP12_ZERO:
+            return fp12_sub(_fp_to_fp12(px), x1)
+        lam = fp12_mul(
+            fp12_mul(fp12_sqr(x1), _fp_to_fp12(3)),
+            fp12_inv(fp12_mul(y1, _fp_to_fp12(2))),
+        )
+    else:
+        x2, y2 = q
+        if x1 == x2:
+            return fp12_sub(_fp_to_fp12(px), x1)
+        lam = fp12_mul(fp12_sub(y2, y1), fp12_inv(fp12_sub(x2, x1)))
+    return fp12_sub(
+        fp12_sub(_fp_to_fp12(py), y1),
+        fp12_mul(lam, fp12_sub(_fp_to_fp12(px), x1)),
+    )
+
+
+def miller_loop(p1_affine, q2_affine):
+    """f_{|x|,Q}(P) with the ate loop count |X_PARAM|; conjugated for x < 0."""
+    if p1_affine is None or q2_affine is None:
+        return FP12_ONE
+    q = _untwist(q2_affine)
+    t = q
+    f = FP12_ONE
+    n = -X_PARAM  # positive loop count
+    for i in range(n.bit_length() - 2, -1, -1):
+        f = fp12_mul(fp12_sqr(f), _line(t, t, p1_affine))
+        t = _e12_add(t, t)
+        if (n >> i) & 1:
+            f = fp12_mul(f, _line(t, q, p1_affine))
+            t = _e12_add(t, q)
+    # X_PARAM < 0: f_{-n} ~ conj(f_n) up to final exponentiation.
+    return fp12_conj(f)
+
+
+# Final exponentiation: (p^12-1)/r = (p^6-1)(p^2+1) * h, with the hard part h
+# decomposed in base p and evaluated with Frobenius + 4-way Shamir multiexp.
+_HARD = (P**4 - P**2 + 1) // R
+_HARD_DIGITS = []
+_tmp = _HARD
+for _ in range(4):
+    _HARD_DIGITS.append(_tmp % P)
+    _tmp //= P
+assert _tmp == 0
+
+
+def _final_exp_hard(m):
+    frobs = [m]
+    for _ in range(3):
+        frobs.append(fp12_frobenius(frobs[-1]))
+    # Shamir: precompute products of subsets of {m, m^p, m^p2, m^p3}.
+    table = [FP12_ONE] * 16
+    for mask in range(1, 16):
+        low = mask & (-mask)
+        idx = low.bit_length() - 1
+        table[mask] = fp12_mul(table[mask ^ low], frobs[idx])
+    nbits = max(d.bit_length() for d in _HARD_DIGITS)
+    acc = FP12_ONE
+    for i in range(nbits - 1, -1, -1):
+        acc = fp12_sqr(acc)
+        mask = 0
+        for j in range(4):
+            if (_HARD_DIGITS[j] >> i) & 1:
+                mask |= 1 << j
+        if mask:
+            acc = fp12_mul(acc, table[mask])
+    return acc
+
+
+def final_exponentiation(f):
+    # easy part: f^((p^6-1)(p^2+1))
+    t = fp12_mul(fp12_conj(f), fp12_inv(f))  # f^(p^6-1)
+    t = fp12_mul(fp12_frobenius_n(t, 2), t)  # ^(p^2+1)
+    return _final_exp_hard(t)
+
+
+def pairing(p1, q2):
+    """e(P, Q) for P in G1 (Jacobian), Q in G2 (Jacobian) -> Fp12.
+
+    Parity: GT.Pairing(G1, G2) in the reference's MCL binding
+    (src/Lachain.Crypto/TPKE/PublicKey.cs:88-92 usage).
+    """
+    return final_exponentiation(
+        miller_loop(g1_to_affine(p1), g2_to_affine(q2))
+    )
+
+
+def multi_pairing(pairs: Sequence[Tuple[tuple, tuple]]):
+    """Prod e(Pi, Qi) sharing one final exponentiation."""
+    f = FP12_ONE
+    for p1, q2 in pairs:
+        f = fp12_mul(f, miller_loop(g1_to_affine(p1), g2_to_affine(q2)))
+    return final_exponentiation(f)
+
+
+def pairings_equal(p_a, q_a, p_b, q_b) -> bool:
+    """e(Pa, Qa) == e(Pb, Qb) via Prod e(Pa,Qa)*e(-Pb,Qb) == 1 (one final exp).
+
+    This is the per-share check shape of TPKE VerifyShare
+    (reference: src/Lachain.Crypto/TPKE/PublicKey.cs:88-92) and threshold-sig
+    share validation (ThresholdSignature/PublicKey.cs:15-20).
+    """
+    return fp12_eq_one(multi_pairing([(p_a, q_a), (g1_neg(p_b), q_b)]))
+
+
+# ---------------------------------------------------------------------------
+# Hash-to-curve: XOF-driven try-and-increment + cofactor clearing.
+# (Our chain defines its own hash-to-curve; wire compat with MCL's SetHashOf
+# is intentionally NOT a goal — see SURVEY.md §7 "hard parts" #2.)
+# ---------------------------------------------------------------------------
+
+
+def _xof(domain: bytes, msg: bytes, nbytes: int) -> bytes:
+    h = hashlib.shake_256()
+    h.update(len(domain).to_bytes(1, "big") + domain + msg)
+    return h.digest(nbytes)
+
+
+def hash_to_fr(msg: bytes, domain: bytes = b"LTPU-FR") -> int:
+    return int.from_bytes(_xof(domain, msg, 48), "big") % R
+
+
+def hash_to_g1(msg: bytes, domain: bytes = b"LTPU-G1") -> tuple:
+    ctr = 0
+    while True:
+        xb = _xof(domain + b"|" + ctr.to_bytes(4, "big"), msg, 64)
+        x = int.from_bytes(xb, "big") % P
+        y = fp_sqrt((x * x * x + B_G1) % P)
+        if y is not None:
+            if y > P - y:
+                y = P - y
+            pt = (x, y, 1)
+            return g1_mul(pt, H_G1)
+        ctr += 1
+
+
+def hash_to_g2(msg: bytes, domain: bytes = b"LTPU-G2") -> tuple:
+    """Deterministic hash to the G2 subgroup (role of G2.SetHashOf in MCL)."""
+    ctr = 0
+    b2 = fp2_muls(XI, B_G1)
+    while True:
+        xb = _xof(domain + b"|" + ctr.to_bytes(4, "big"), msg, 128)
+        x = (
+            int.from_bytes(xb[:64], "big") % P,
+            int.from_bytes(xb[64:], "big") % P,
+        )
+        rhs = fp2_add(fp2_mul(fp2_sqr(x), x), b2)
+        y = fp2_sqrt(rhs)
+        if y is not None:
+            if (y[1], y[0]) > (P - y[1], P - y[0]):
+                y = fp2_neg(y)
+            pt = (x, y, FP2_ONE)
+            return g2_mul(pt, H_G2)
+        ctr += 1
+
+
+# ---------------------------------------------------------------------------
+# Fr (scalar field) polynomial helpers — parity with MclBls12381.
+# ---------------------------------------------------------------------------
+
+
+def fr_eval_poly(coeffs: Sequence[int], x: int) -> int:
+    """Evaluate sum coeffs[i] * x^i mod r (MclBls12381.EvaluatePolynomial)."""
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc
+
+
+def fr_lagrange_coeffs(xs: Sequence[int], at: int = 0) -> List[int]:
+    """Lagrange basis coefficients l_i(at) for interpolation points xs mod r."""
+    n = len(xs)
+    assert len(set(x % R for x in xs)) == n, "duplicate interpolation points"
+    coeffs = []
+    for i in range(n):
+        num, den = 1, 1
+        for j in range(n):
+            if i == j:
+                continue
+            num = num * ((at - xs[j]) % R) % R
+            den = den * ((xs[i] - xs[j]) % R) % R
+        coeffs.append(num * pow(den, R - 2, R) % R)
+    return coeffs
+
+
+def fr_interpolate(xs: Sequence[int], ys: Sequence[int], at: int = 0) -> int:
+    """Scalar Lagrange interpolation (MclBls12381.LagrangeInterpolate)."""
+    cs = fr_lagrange_coeffs(xs, at)
+    return sum(c * y for c, y in zip(cs, ys)) % R
+
+
+def g1_interpolate(xs: Sequence[int], pts: Sequence[tuple], at: int = 0):
+    """Interpolate G1 points at `at` (TPKE FullDecrypt combine shape,
+    reference: src/Lachain.Crypto/TPKE/PublicKey.cs:55-86)."""
+    cs = fr_lagrange_coeffs(xs, at)
+    acc = G1_INF
+    for c, pt in zip(cs, pts):
+        acc = g1_add(acc, g1_mul(pt, c))
+    return acc
+
+
+def g2_interpolate(xs: Sequence[int], pts: Sequence[tuple], at: int = 0):
+    """Interpolate G2 points (threshold-signature combine shape,
+    reference: src/Lachain.Crypto/ThresholdSignature/PublicKeySet.cs:35-44)."""
+    cs = fr_lagrange_coeffs(xs, at)
+    acc = G2_INF
+    for c, pt in zip(cs, pts):
+        acc = g2_add(acc, g2_mul(pt, c))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Serialization: fixed-width big-endian, uncompressed. All-zero == infinity.
+#   Fr: 32 bytes | G1: 96 bytes (x || y) | G2: 192 bytes (x0 x1 y0 y1)
+# ---------------------------------------------------------------------------
+
+FR_BYTES = 32
+G1_BYTES = 96
+G2_BYTES = 192
+
+
+def fr_to_bytes(a: int) -> bytes:
+    return (a % R).to_bytes(FR_BYTES, "big")
+
+
+def fr_from_bytes(b: bytes) -> int:
+    assert len(b) == FR_BYTES
+    v = int.from_bytes(b, "big")
+    if v >= R:
+        raise ValueError("Fr out of range")
+    return v
+
+
+def g1_to_bytes(pt) -> bytes:
+    aff = g1_to_affine(pt)
+    if aff is None:
+        return b"\x00" * G1_BYTES
+    return aff[0].to_bytes(48, "big") + aff[1].to_bytes(48, "big")
+
+
+def g1_from_bytes(b: bytes, check_subgroup: bool = True) -> tuple:
+    assert len(b) == G1_BYTES
+    if b == b"\x00" * G1_BYTES:
+        return G1_INF
+    x = int.from_bytes(b[:48], "big")
+    y = int.from_bytes(b[48:], "big")
+    if x >= P or y >= P:
+        raise ValueError("G1 coordinate out of range")
+    pt = (x, y, 1)
+    if not g1_is_on_curve(pt):
+        raise ValueError("G1 point not on curve")
+    if check_subgroup and not g1_is_inf(g1_mul(pt, R)):
+        raise ValueError("G1 point not in subgroup")
+    return pt
+
+
+def g2_to_bytes(pt) -> bytes:
+    aff = g2_to_affine(pt)
+    if aff is None:
+        return b"\x00" * G2_BYTES
+    (x0, x1), (y0, y1) = aff
+    return b"".join(v.to_bytes(48, "big") for v in (x0, x1, y0, y1))
+
+
+def g2_from_bytes(b: bytes, check_subgroup: bool = True) -> tuple:
+    assert len(b) == G2_BYTES
+    if b == b"\x00" * G2_BYTES:
+        return G2_INF
+    vals = [int.from_bytes(b[i * 48 : (i + 1) * 48], "big") for i in range(4)]
+    if any(v >= P for v in vals):
+        raise ValueError("G2 coordinate out of range")
+    pt = ((vals[0], vals[1]), (vals[2], vals[3]), FP2_ONE)
+    if not g2_is_on_curve(pt):
+        raise ValueError("G2 point not on curve")
+    if check_subgroup and not g2_is_inf(g2_mul(pt, R)):
+        raise ValueError("G2 point not in subgroup")
+    return pt
+
+
+def gt_to_bytes(a) -> bytes:
+    out = []
+    for c6 in a:
+        for c2 in c6:
+            for v in c2:
+                out.append((v % P).to_bytes(48, "big"))
+    return b"".join(out)

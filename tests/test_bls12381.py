@@ -1,0 +1,127 @@
+"""BLS12-381 oracle conformance tests.
+
+Mirrors the reference's MCL primitive sanity suite
+(/root/reference/test/Lachain.CryptoTest/MclTests.cs:15-109): serialization
+roundtrips, pairing bilinearity, polynomial evaluate/interpolate identity.
+"""
+import random
+
+import pytest
+
+from lachain_tpu.crypto import bls12381 as bls
+
+
+def test_subgroup_orders():
+    assert bls.g1_is_inf(bls.g1_mul(bls.G1_GEN, bls.R))
+    assert bls.g2_is_inf(bls.g2_mul(bls.G2_GEN, bls.R))
+    # cofactors are consistent with the curve orders
+    assert bls.H_G1 * bls.R == bls.N_G1
+    assert bls.H_G2 * bls.R == bls.N_G2
+
+
+def test_g1_group_laws():
+    rng = random.Random(42)
+    a, b = rng.randrange(bls.R), rng.randrange(bls.R)
+    pa = bls.g1_mul(bls.G1_GEN, a)
+    pb = bls.g1_mul(bls.G1_GEN, b)
+    assert bls.g1_eq(bls.g1_add(pa, pb), bls.g1_mul(bls.G1_GEN, (a + b) % bls.R))
+    assert bls.g1_eq(bls.g1_add(pa, bls.g1_neg(pa)), bls.G1_INF)
+    assert bls.g1_eq(bls.g1_add(pa, bls.G1_INF), pa)
+    assert bls.g1_eq(bls.g1_dbl(pa), bls.g1_mul(bls.G1_GEN, 2 * a % bls.R))
+
+
+def test_g2_group_laws():
+    rng = random.Random(43)
+    a, b = rng.randrange(bls.R), rng.randrange(bls.R)
+    pa = bls.g2_mul(bls.G2_GEN, a)
+    pb = bls.g2_mul(bls.G2_GEN, b)
+    assert bls.g2_eq(bls.g2_add(pa, pb), bls.g2_mul(bls.G2_GEN, (a + b) % bls.R))
+    assert bls.g2_eq(bls.g2_add(pa, bls.g2_neg(pa)), bls.G2_INF)
+
+
+def test_serialization_roundtrip():
+    rng = random.Random(44)
+    k = rng.randrange(bls.R)
+    p1 = bls.g1_mul(bls.G1_GEN, k)
+    p2 = bls.g2_mul(bls.G2_GEN, k)
+    assert bls.g1_eq(bls.g1_from_bytes(bls.g1_to_bytes(p1)), p1)
+    assert bls.g2_eq(bls.g2_from_bytes(bls.g2_to_bytes(p2)), p2)
+    assert bls.g1_from_bytes(bls.g1_to_bytes(bls.G1_INF)) == bls.G1_INF
+    assert bls.fr_from_bytes(bls.fr_to_bytes(k)) == k
+
+
+def test_fp2_sqrt():
+    rng = random.Random(45)
+    for _ in range(8):
+        a = (rng.randrange(bls.P), rng.randrange(bls.P))
+        sq = bls.fp2_sqr(a)
+        s = bls.fp2_sqrt(sq)
+        assert s is not None
+        assert bls.fp2_sqr(s) == sq
+
+
+def test_pairing_bilinearity():
+    rng = random.Random(46)
+    a, b = rng.randrange(1, 2**64), rng.randrange(1, 2**64)
+    pa = bls.g1_mul(bls.G1_GEN, a)
+    qb = bls.g2_mul(bls.G2_GEN, b)
+    # e(aP, bQ) == e(P, Q)^(ab)
+    lhs = bls.pairing(pa, qb)
+    base = bls.pairing(bls.G1_GEN, bls.G2_GEN)
+    rhs = bls.fp12_pow(base, a * b)
+    assert lhs == rhs
+    # e(P, Q) has order r: e^r == 1
+    assert bls.fp12_eq_one(bls.fp12_pow(base, bls.R))
+    assert not bls.fp12_eq_one(base)
+
+
+def test_pairing_equality_check():
+    rng = random.Random(47)
+    x = rng.randrange(bls.R)
+    rr = rng.randrange(bls.R)
+    # u_i = g1^(r*x), H in G2, w = H^r, y_i = g1^x:
+    # e(u_i, H) == e(y_i, w)  — the TPKE VerifyShare relation.
+    h = bls.hash_to_g2(b"test-coin")
+    u_i = bls.g1_mul(bls.G1_GEN, rr * x % bls.R)
+    y_i = bls.g1_mul(bls.G1_GEN, x)
+    w = bls.g2_mul(h, rr)
+    assert bls.pairings_equal(u_i, h, y_i, w)
+    # corrupt one side -> must fail
+    bad = bls.g1_mul(u_i, 2)
+    assert not bls.pairings_equal(bad, h, y_i, w)
+
+
+def test_hash_to_curve_in_subgroup():
+    g1p = bls.hash_to_g1(b"hello")
+    g2p = bls.hash_to_g2(b"hello")
+    assert bls.g1_in_subgroup(g1p)
+    assert bls.g2_in_subgroup(g2p)
+    assert not bls.g1_is_inf(g1p)
+    assert not bls.g2_is_inf(g2p)
+    # deterministic
+    assert bls.g1_eq(bls.hash_to_g1(b"hello"), g1p)
+    assert not bls.g1_eq(bls.hash_to_g1(b"hellp"), g1p)
+
+
+def test_eval_interpolate_identity():
+    # mirrors MclTests evaluate/interpolate identity
+    rng = random.Random(48)
+    coeffs = [rng.randrange(bls.R) for _ in range(4)]  # degree 3
+    xs = [1, 2, 3, 5, 8]
+    ys = [bls.fr_eval_poly(coeffs, x) for x in xs]
+    assert bls.fr_interpolate(xs[:4], ys[:4], at=0) == coeffs[0]
+    assert bls.fr_interpolate(xs[1:], ys[1:], at=0) == coeffs[0]
+    at = rng.randrange(bls.R)
+    assert bls.fr_interpolate(xs[:4], ys[:4], at) == bls.fr_eval_poly(coeffs, at)
+
+
+def test_group_interpolation():
+    rng = random.Random(49)
+    coeffs = [rng.randrange(bls.R) for _ in range(3)]
+    xs = [1, 2, 4]
+    g1_pts = [bls.g1_mul(bls.G1_GEN, bls.fr_eval_poly(coeffs, x)) for x in xs]
+    combined = bls.g1_interpolate(xs, g1_pts, at=0)
+    assert bls.g1_eq(combined, bls.g1_mul(bls.G1_GEN, coeffs[0]))
+    g2_pts = [bls.g2_mul(bls.G2_GEN, bls.fr_eval_poly(coeffs, x)) for x in xs]
+    combined2 = bls.g2_interpolate(xs, g2_pts, at=0)
+    assert bls.g2_eq(combined2, bls.g2_mul(bls.G2_GEN, coeffs[0]))

@@ -1,0 +1,53 @@
+"""Hash primitive tests (known-answer vectors + Merkle tree).
+
+Merkle shape mirrors the reference's MerkleTree usage in ReliableBroadcast
+(/root/reference/src/Lachain.Consensus/ReliableBroadcast/ReliableBroadcast.cs:296-309).
+"""
+from lachain_tpu.crypto import hashes
+
+
+def test_keccak256_vectors():
+    # Well-known Keccak-256 (pre-NIST padding) vectors.
+    assert (
+        hashes.keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        hashes.keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block input (> 136-byte rate)
+    long = b"a" * 300
+    assert len(hashes.keccak256(long)) == 32
+    assert hashes.keccak256(long) != hashes.keccak256(b"a" * 299)
+
+
+def test_xof_domain_separation():
+    a = hashes.xof(b"d1", b"msg", 64)
+    b = hashes.xof(b"d2", b"msg", 64)
+    assert a != b
+    assert len(a) == 64
+    assert hashes.xof(b"d1", b"msg", 64) == a
+
+
+def test_merkle_root_and_proof():
+    leaves = [hashes.keccak256(bytes([i])) for i in range(7)]
+    root = hashes.merkle_root(leaves)
+    assert root is not None
+    for i, leaf in enumerate(leaves):
+        proof = hashes.merkle_proof(leaves, i)
+        assert hashes.merkle_verify(leaf, i, proof, root)
+        # wrong index / wrong leaf fail
+        assert not hashes.merkle_verify(leaf, (i + 1) % 7, proof, root)
+        assert not hashes.merkle_verify(hashes.keccak256(b"x"), i, proof, root)
+    assert hashes.merkle_root([]) is None
+    assert hashes.merkle_root([leaves[0]]) == leaves[0]
+
+
+def test_merkle_sizes():
+    for n in (1, 2, 3, 4, 5, 8, 16, 31):
+        leaves = [hashes.keccak256(bytes([i, n])) for i in range(n)]
+        root = hashes.merkle_root(leaves)
+        for i in range(n):
+            proof = hashes.merkle_proof(leaves, i)
+            assert hashes.merkle_verify(leaves[i], i, proof, root), (n, i)
