@@ -1,0 +1,175 @@
+"""ctypes binding for libbls381 (the native C++ BLS12-381 backend).
+
+Builds on demand (make in lachain_tpu/crypto/native) and exposes the same
+backend interface as PythonBackend (lachain_tpu.crypto.provider). Points cross
+the boundary in the shared wire format (BE uncompressed; see bls12381.py),
+internally converting to/from the oracle's tuple representation so the rest of
+the Python stack is backend-agnostic.
+
+Role parity: the MCL native binding in the reference
+(/root/reference/src/Lachain.Crypto/MclBls12381.cs).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Sequence, Tuple
+
+from . import bls12381 as bls
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbls381.so")
+
+
+def _build_if_needed() -> None:
+    src = os.path.join(_NATIVE_DIR, "bls381.cpp")
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+        return
+    subprocess.run(
+        ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
+    )
+
+
+def load_lib():
+    _build_if_needed()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.lt_version.restype = ctypes.c_int
+    assert lib.lt_version() == 1
+    return lib
+
+
+def _scalar32(s: int) -> bytes:
+    return (s % bls.R).to_bytes(32, "big")
+
+
+class NativeBackend:
+    """Backend implementation delegating hot ops to libbls381."""
+
+    name = "native"
+
+    def __init__(self):
+        self._lib = load_lib()
+
+    # -- group ops -----------------------------------------------------------
+    def g1_mul(self, point: tuple, scalar: int) -> tuple:
+        out = ctypes.create_string_buffer(96)
+        rc = self._lib.lt_g1_mul(
+            bls.g1_to_bytes(point), _scalar32(scalar), out
+        )
+        if rc != 0:
+            raise ValueError("native g1_mul failed")
+        return bls.g1_from_bytes(out.raw, check_subgroup=False)
+
+    def g2_mul(self, point: tuple, scalar: int) -> tuple:
+        out = ctypes.create_string_buffer(192)
+        rc = self._lib.lt_g2_mul(
+            bls.g2_to_bytes(point), _scalar32(scalar), out
+        )
+        if rc != 0:
+            raise ValueError("native g2_mul failed")
+        return bls.g2_from_bytes(out.raw, check_subgroup=False)
+
+    def g1_msm(self, points: Sequence[tuple], scalars: Sequence[int]) -> tuple:
+        if len(points) != len(scalars):
+            raise ValueError("g1_msm: points/scalars length mismatch")
+        if not points:
+            return bls.G1_INF
+        pts = b"".join(bls.g1_to_bytes(p) for p in points)
+        ss = b"".join(_scalar32(s) for s in scalars)
+        out = ctypes.create_string_buffer(96)
+        rc = self._lib.lt_g1_msm(pts, ss, len(points), out)
+        if rc != 0:
+            raise ValueError("native g1_msm failed")
+        return bls.g1_from_bytes(out.raw, check_subgroup=False)
+
+    def g2_msm(self, points: Sequence[tuple], scalars: Sequence[int]) -> tuple:
+        if len(points) != len(scalars):
+            raise ValueError("g2_msm: points/scalars length mismatch")
+        if not points:
+            return bls.G2_INF
+        pts = b"".join(bls.g2_to_bytes(p) for p in points)
+        ss = b"".join(_scalar32(s) for s in scalars)
+        out = ctypes.create_string_buffer(192)
+        rc = self._lib.lt_g2_msm(pts, ss, len(points), out)
+        if rc != 0:
+            raise ValueError("native g2_msm failed")
+        return bls.g2_from_bytes(out.raw, check_subgroup=False)
+
+    # -- pairings ------------------------------------------------------------
+    def pairing_check(self, pairs: Sequence[Tuple[tuple, tuple]]) -> bool:
+        if not pairs:
+            return True
+        g1s = b"".join(bls.g1_to_bytes(p) for p, _ in pairs)
+        g2s = b"".join(bls.g2_to_bytes(q) for _, q in pairs)
+        rc = self._lib.lt_pairing_check(g1s, g2s, len(pairs))
+        if rc < 0:
+            raise ValueError("native pairing_check: bad encoding")
+        return rc == 1
+
+    def pairings_equal(self, p_a, q_a, p_b, q_b) -> bool:
+        return self.pairing_check([(p_a, q_a), (bls.g1_neg(p_b), q_b)])
+
+    def multi_pairing_bytes(
+        self, pairs: Sequence[Tuple[tuple, tuple]]
+    ) -> bytes:
+        """GT output serialized — for conformance tests vs the oracle."""
+        g1s = b"".join(bls.g1_to_bytes(p) for p, _ in pairs)
+        g2s = b"".join(bls.g2_to_bytes(q) for _, q in pairs)
+        out = ctypes.create_string_buffer(576)
+        rc = self._lib.lt_multi_pairing(g1s, g2s, len(pairs), out)
+        if rc != 0:
+            raise ValueError("native multi_pairing failed")
+        return out.raw
+
+    # -- hashing -------------------------------------------------------------
+    def hash_to_g1(self, msg: bytes, domain: bytes = b"LTPU-G1") -> tuple:
+        out = ctypes.create_string_buffer(96)
+        self._lib.lt_hash_to_g1(msg, len(msg), domain, len(domain), out)
+        return bls.g1_from_bytes(out.raw, check_subgroup=False)
+
+    def hash_to_g2(self, msg: bytes, domain: bytes = b"LTPU-G2") -> tuple:
+        out = ctypes.create_string_buffer(192)
+        self._lib.lt_hash_to_g2(msg, len(msg), domain, len(domain), out)
+        return bls.g2_from_bytes(out.raw, check_subgroup=False)
+
+    # -- wire deserialization (native on-curve + subgroup check) -------------
+    def g1_deserialize(self, data: bytes) -> tuple:
+        if len(data) != bls.G1_BYTES:
+            raise ValueError("bad G1 encoding length")
+        if self._lib.lt_g1_check(data) != 2:
+            raise ValueError("G1 point invalid or not in subgroup")
+        return bls.g1_from_bytes(data, check_subgroup=False)
+
+    def g2_deserialize(self, data: bytes) -> tuple:
+        if len(data) != bls.G2_BYTES:
+            raise ValueError("bad G2 encoding length")
+        if self._lib.lt_g2_check(data) != 2:
+            raise ValueError("G2 point invalid or not in subgroup")
+        return bls.g2_from_bytes(data, check_subgroup=False)
+
+    def keccak256(self, data: bytes) -> bytes:
+        out = ctypes.create_string_buffer(32)
+        self._lib.lt_keccak256(data, len(data), out)
+        return out.raw
+
+    # -- baseline proxy ------------------------------------------------------
+    def tpke_verify_shares_serial(
+        self,
+        uis: Sequence[tuple],
+        yis: Sequence[tuple],
+        h: tuple,
+        w: tuple,
+    ) -> List[bool]:
+        """Reference-style serial loop: 2 pairings per share (the baseline
+        the batched TPU path is measured against — BASELINE.md)."""
+        n = len(uis)
+        ub = b"".join(bls.g1_to_bytes(u) for u in uis)
+        yb = b"".join(bls.g1_to_bytes(y) for y in yis)
+        res = ctypes.create_string_buffer(n)
+        rc = self._lib.lt_tpke_verify_shares_serial(
+            ub, yb, n, bls.g2_to_bytes(h), bls.g2_to_bytes(w), res
+        )
+        if rc != 0:
+            raise ValueError("native serial verify failed")
+        return [b == 1 for b in res.raw]

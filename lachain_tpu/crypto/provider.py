@@ -1,0 +1,154 @@
+"""Crypto backend provider seam.
+
+Parity with the reference's provider seam (`ICrypto` / `CryptoProvider`,
+/root/reference/src/Lachain.Crypto/CryptoProvider.cs:3-11 and ICrypto.cs:5-117):
+all threshold-crypto consumers go through a small backend interface so the
+implementation can be swapped without touching consensus code.
+
+Three backends exist (or will):
+  * ``python``  — the pure-Python oracle (lachain_tpu.crypto.bls12381).
+  * ``native``  — C++ libbls381 via ctypes (fast host path; MCL equivalent).
+  * ``tpu``     — JAX batched kernels for the MSM-heavy batch ops
+                  (lachain_tpu.ops); pairings delegate to native/python.
+
+The batch operations are the TPU-first redesign: where the reference verifies
+each decryption share with 2 pairings (TPKE/PublicKey.cs:88-92, executed
+serially per message), we reduce a whole batch to ONE pairing equality via a
+random-linear-combination MSM, so the hot op becomes a batched G1/G2 MSM —
+exactly the shape TPUs are good at.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from . import bls12381 as bls
+
+
+class PythonBackend:
+    """Oracle backend: direct calls into the pure-Python BLS12-381 module."""
+
+    name = "python"
+
+    # -- group ops -----------------------------------------------------------
+    def g1_msm(self, points: Sequence[tuple], scalars: Sequence[int]) -> tuple:
+        acc = bls.G1_INF
+        for pt, s in zip(points, scalars):
+            acc = bls.g1_add(acc, bls.g1_mul(pt, s))
+        return acc
+
+    def g2_msm(self, points: Sequence[tuple], scalars: Sequence[int]) -> tuple:
+        acc = bls.G2_INF
+        for pt, s in zip(points, scalars):
+            acc = bls.g2_add(acc, bls.g2_mul(pt, s))
+        return acc
+
+    def g1_mul(self, point: tuple, scalar: int) -> tuple:
+        return bls.g1_mul(point, scalar)
+
+    def g2_mul(self, point: tuple, scalar: int) -> tuple:
+        return bls.g2_mul(point, scalar)
+
+    # -- pairings ------------------------------------------------------------
+    def pairing_check(
+        self, pairs: Sequence[Tuple[tuple, tuple]]
+    ) -> bool:
+        """Prod e(Pi, Qi) == 1 with one shared final exponentiation."""
+        return bls.fp12_eq_one(bls.multi_pairing(pairs))
+
+    def pairings_equal(self, p_a, q_a, p_b, q_b) -> bool:
+        return bls.pairings_equal(p_a, q_a, p_b, q_b)
+
+    # -- hashing -------------------------------------------------------------
+    def hash_to_g1(self, msg: bytes, domain: bytes = b"LTPU-G1") -> tuple:
+        return bls.hash_to_g1(msg, domain)
+
+    def hash_to_g2(self, msg: bytes, domain: bytes = b"LTPU-G2") -> tuple:
+        return bls.hash_to_g2(msg, domain)
+
+    # -- wire deserialization (on-curve + subgroup validation) ---------------
+    def g1_deserialize(self, data: bytes) -> tuple:
+        return bls.g1_from_bytes(data, check_subgroup=True)
+
+    def g2_deserialize(self, data: bytes) -> tuple:
+        return bls.g2_from_bytes(data, check_subgroup=True)
+
+
+def batch_bisect_verify(group_ok, n: int) -> List[bool]:
+    """Shared bisection driver for random-linear-combination batch checks.
+
+    `group_ok(idx_list) -> bool` must be a probabilistic check that a subset of
+    items is all-valid (e.g. an RLC pairing equality). Returns per-item
+    validity; cost is one group check when everything is valid, and
+    O(log n) group checks per invalid item otherwise. Used by both TPKE
+    decryption-share verification and threshold-signature share verification
+    so the soundness-critical logic lives in exactly one place.
+    """
+    results = [False] * n
+
+    def solve(idx):
+        if group_ok(idx):
+            for i in idx:
+                results[i] = True
+            return
+        if len(idx) == 1:
+            return
+        mid = len(idx) // 2
+        solve(idx[:mid])
+        solve(idx[mid:])
+
+    if n:
+        solve(list(range(n)))
+    return results
+
+
+def select_distinct(shares, key, count: int):
+    """First `count` shares with distinct `key(share)`, or None if impossible.
+
+    Used before Lagrange combination: duplicates are skipped (not an error)
+    so a caller holding [id0, id0, id1, id2] can still combine t+1 = 3
+    distinct shares.
+    """
+    seen = set()
+    out = []
+    for s in shares:
+        k = key(s)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(s)
+        if len(out) == count:
+            return out
+    return None
+
+
+_BACKEND = None
+
+
+def get_backend():
+    """Singleton accessor (role of CryptoProvider.GetCrypto in the reference).
+
+    Resolution order: $LACHAIN_TPU_BACKEND if set, else native C++ if the
+    shared library built, else the Python oracle.
+    """
+    global _BACKEND
+    if _BACKEND is not None:
+        return _BACKEND
+    choice = os.environ.get("LACHAIN_TPU_BACKEND", "auto")
+    if choice in ("native", "auto"):
+        try:
+            from .native_backend import NativeBackend
+
+            _BACKEND = NativeBackend()
+            return _BACKEND
+        except Exception:
+            if choice == "native":
+                raise
+    _BACKEND = PythonBackend()
+    return _BACKEND
+
+
+def set_backend(backend) -> None:
+    global _BACKEND
+    _BACKEND = backend

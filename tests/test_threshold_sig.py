@@ -1,0 +1,149 @@
+"""Threshold-signature tests.
+
+Mirrors /root/reference/test/Lachain.CryptoTest/ThresholdSignatureTest.cs:10-45
+(all-pairs AddShare matrix at N=7 F=2) plus batch verification and the
+ThresholdSigner state machine used by CommonCoin.
+"""
+import random
+
+import pytest
+
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.crypto import threshold_sig as ts
+
+
+class SeededRng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+N, F = 7, 2
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return ts.TsTrustedKeyGen(N, F, rng=SeededRng(555))
+
+
+def test_sign_verify_share(keys):
+    msg = b"coin|era=1|agreement=2|epoch=3"
+    for i in range(N):
+        ps = keys.private_key_share(i).sign(msg)
+        assert keys.pub_key_set.verify_share(msg, ps)
+        # share must not verify for a different message
+        assert not keys.pub_key_set.verify_share(b"other", ps)
+
+
+def test_combine_any_subset(keys):
+    rng = random.Random(77)
+    msg = b"block header hash"
+    shares = [keys.private_key_share(i).sign(msg) for i in range(N)]
+    combined_sigs = []
+    for _ in range(4):
+        subset = rng.sample(shares, F + 1)
+        sig = keys.pub_key_set.combine(subset)
+        assert keys.pub_key_set.shared.verify(msg, sig)
+        combined_sigs.append(sig.to_bytes())
+    # all subsets combine to the SAME signature (deterministic coin!)
+    assert len(set(combined_sigs)) == 1
+
+
+def test_signer_state_machine(keys):
+    """All-pairs matrix: every signer collects every other's share
+    (reference ThresholdSignatureTest.cs shape)."""
+    msg = b"all-pairs"
+    shares = [keys.private_key_share(i).sign(msg) for i in range(N)]
+    for i in range(N):
+        signer = ts.ThresholdSigner(
+            msg, keys.private_key_share(i), keys.pub_key_set
+        )
+        for ps in shares:
+            assert signer.add_share(ps)
+        assert signer.signature is not None
+        assert keys.pub_key_set.shared.verify(msg, signer.signature)
+
+
+def test_signer_rejects_bad_share(keys):
+    msg = b"bad share test"
+    signer = ts.ThresholdSigner(
+        msg, keys.private_key_share(0), keys.pub_key_set
+    )
+    good = keys.private_key_share(1).sign(msg)
+    bad = ts.PartialSignature(
+        sigma=bls.g2_mul(good.sigma, 2), signer_id=2
+    )
+    assert not signer.add_share(bad)
+    assert signer.add_share(good)
+    out_of_range = ts.PartialSignature(sigma=good.sigma, signer_id=99)
+    assert not signer.add_share(out_of_range)
+
+
+def test_deferred_verification_prunes_bad_shares(keys):
+    """Regression: with verify=False, a bad share among the first t+1 must not
+    stall the signer forever — it is pruned once combine fails."""
+    msg = b"deferred"
+    signer = ts.ThresholdSigner(
+        msg, keys.private_key_share(0), keys.pub_key_set
+    )
+    bad = ts.PartialSignature(
+        sigma=bls.g2_mul(keys.private_key_share(1).sign(msg).sigma, 7),
+        signer_id=1,
+    )
+    assert signer.add_share(bad, verify=False)
+    for i in (0, 2, 3):
+        signer.add_share(keys.private_key_share(i).sign(msg), verify=False)
+    assert signer.signature is not None
+    assert keys.pub_key_set.shared.verify(msg, signer.signature)
+
+
+def test_batch_verify_out_of_range_signer(keys):
+    msg = b"range"
+    shares = [keys.private_key_share(i).sign(msg) for i in range(3)]
+    shares.append(ts.PartialSignature(sigma=shares[0].sigma, signer_id=500))
+    oks = keys.pub_key_set.batch_verify_shares(msg, shares)
+    assert oks == [True, True, True, False]
+
+
+def test_combine_skips_duplicates(keys):
+    msg = b"dups"
+    s0 = keys.private_key_share(0).sign(msg)
+    s1 = keys.private_key_share(1).sign(msg)
+    s2 = keys.private_key_share(2).sign(msg)
+    sig = keys.pub_key_set.combine([s0, s0, s1, s2])
+    assert keys.pub_key_set.shared.verify(msg, sig)
+
+
+def test_batch_verify(keys):
+    rng = SeededRng(42)
+    msg = b"batch"
+    shares = [keys.private_key_share(i).sign(msg) for i in range(N)]
+    oks = keys.pub_key_set.batch_verify_shares(msg, shares, rng=rng)
+    assert oks == [True] * N
+    shares[3] = ts.PartialSignature(
+        sigma=bls.g2_mul(shares[3].sigma, 5), signer_id=3
+    )
+    oks = keys.pub_key_set.batch_verify_shares(msg, shares, rng=rng)
+    assert oks == [True, True, True, False, True, True, True]
+
+
+def test_parity_is_deterministic(keys):
+    msg = b"coin toss"
+    shares = [keys.private_key_share(i).sign(msg) for i in range(N)]
+    s1 = keys.pub_key_set.combine(shares[: F + 1])
+    s2 = keys.pub_key_set.combine(shares[F + 1 : 2 * F + 2])
+    assert s1.parity == s2.parity
+
+
+def test_pubkeyset_serialization(keys):
+    data = keys.pub_key_set.to_bytes()
+    pks = ts.TsPublicKeySet.from_bytes(data)
+    assert pks.t == F and pks.n == N
+    assert bls.g1_eq(pks.shared.y, keys.pub_key_set.shared.y)
+    msg = b"roundtrip"
+    ps = keys.private_key_share(2).sign(msg)
+    assert pks.verify_share(msg, ps)
+    ps2 = ts.PartialSignature.from_bytes(ps.to_bytes())
+    assert ps2.signer_id == 2 and bls.g2_eq(ps2.sigma, ps.sigma)

@@ -1,0 +1,116 @@
+"""TPKE roundtrip + adversarial tests.
+
+Mirrors /root/reference/test/Lachain.CryptoTest/TPKETest.cs:22-58 (N=7 F=2
+encrypt -> partial-decrypt -> verify -> combine with random F+1 subsets) plus
+batch-verification coverage for the TPU-first path.
+"""
+import random
+
+import pytest
+
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.crypto import tpke
+
+
+class SeededRng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return tpke.TpkeTrustedKeyGen(n=7, f=2, rng=SeededRng(1234))
+
+
+def test_encrypt_decrypt_roundtrip(keys):
+    rng = SeededRng(99)
+    msg = bytes(rng._r.randrange(256) for _ in range(64))
+    share = keys.pub.encrypt(msg, share_id=3, rng=rng)
+    assert share.v != msg  # actually encrypted
+
+    # wire roundtrip
+    share2 = tpke.EncryptedShare.from_bytes(share.to_bytes())
+    assert share2.v == share.v and share2.share_id == 3
+
+    decs = [keys.private_key(i).decrypt_share(share2) for i in range(7)]
+    # any F+1 = 3 shares reconstruct
+    for trial in range(4):
+        subset = rng._r.sample(decs, 3)
+        out = keys.pub.full_decrypt(share2, subset)
+        assert out == msg
+
+    # fewer than F+1 raises
+    with pytest.raises(ValueError):
+        keys.pub.full_decrypt(share2, decs[:2])
+
+
+def test_share_verification(keys):
+    rng = SeededRng(7)
+    msg = b"batch of transactions" + bytes(43)
+    share = keys.pub.encrypt(msg, share_id=0, rng=rng)
+    decs = [keys.private_key(i).decrypt_share(share) for i in range(7)]
+    for i, d in enumerate(decs):
+        assert keys.pub.verify_share(keys.verification_keys[i], d, share)
+    # share from the wrong validator fails the check against vk_i
+    assert not keys.pub.verify_share(keys.verification_keys[0], decs[1], share)
+    # corrupted share fails
+    bad = tpke.PartiallyDecryptedShare(
+        ui=bls.g1_mul(decs[2].ui, 2), decryptor_id=2, share_id=0
+    )
+    assert not keys.pub.verify_share(keys.verification_keys[2], bad, share)
+
+
+def test_batch_verification(keys):
+    rng = SeededRng(8)
+    msg = bytes(64)
+    share = keys.pub.encrypt(msg, share_id=1, rng=rng)
+    decs = [keys.private_key(i).decrypt_share(share) for i in range(7)]
+    oks = keys.pub.batch_verify_shares(keys.verification_keys, decs, share, rng=rng)
+    assert oks == [True] * 7
+
+    # corrupt shares 2 and 5: batch must isolate exactly those
+    decs[2] = tpke.PartiallyDecryptedShare(
+        ui=bls.g1_mul(decs[2].ui, 3), decryptor_id=2, share_id=1
+    )
+    decs[5] = tpke.PartiallyDecryptedShare(
+        ui=bls.G1_GEN, decryptor_id=5, share_id=1
+    )
+    oks = keys.pub.batch_verify_shares(keys.verification_keys, decs, share, rng=rng)
+    assert oks == [True, True, False, True, True, False, True]
+
+
+def test_ciphertext_validity(keys):
+    rng = SeededRng(9)
+    share = keys.pub.encrypt(b"x" * 32, share_id=0, rng=rng)
+    assert keys.pub.verify_ciphertext(share)
+    # tamper with w -> ciphertext check fails and decrypt_share raises
+    bad = tpke.EncryptedShare(
+        u=share.u, v=share.v, w=bls.g2_mul(share.w, 2), share_id=0
+    )
+    assert not keys.pub.verify_ciphertext(bad)
+    with pytest.raises(ValueError):
+        keys.private_key(0).decrypt_share(bad)
+
+
+def test_wrong_subset_gives_garbage(keys):
+    # combining shares from a DIFFERENT ciphertext decrypts to garbage, not msg
+    rng = SeededRng(10)
+    msg = b"m" * 32
+    s1 = keys.pub.encrypt(msg, share_id=0, rng=rng)
+    s2 = keys.pub.encrypt(msg, share_id=1, rng=rng)
+    decs_wrong = [keys.private_key(i).decrypt_share(s2) for i in range(3)]
+    out = keys.pub.full_decrypt(s1, decs_wrong)
+    assert out != msg
+
+
+def test_key_serialization(keys):
+    pk2 = tpke.TpkePublicKey.from_bytes(keys.pub.to_bytes())
+    assert bls.g1_eq(pk2.y, keys.pub.y) and pk2.t == keys.pub.t
+    sk = keys.private_key(4)
+    sk2 = tpke.TpkePrivateKey.from_bytes(sk.to_bytes())
+    assert sk2.x_i == sk.x_i and sk2.my_id == 4
+    vk2 = tpke.TpkeVerificationKey.from_bytes(keys.verification_keys[1].to_bytes())
+    assert bls.g1_eq(vk2.y_i, keys.verification_keys[1].y_i)
