@@ -1,0 +1,68 @@
+"""CommonCoin: threshold signature of the coin id; coin = signature parity.
+
+Behavioral parity with the reference
+(/root/reference/src/Lachain.Consensus/CommonCoin/CommonCoin.cs):
+  * on request: sign CoinId bytes with my TS share, broadcast (117-124)
+  * collect + verify shares; combine at t+1 (75-96)
+  * coin bit = combined signature parity (CoinResult.cs:15-19)
+
+TPU-first note: share verification goes through ThresholdSigner, whose
+deferred-batch mode routes to the RLC batch verifier (2 pairings + MSM per
+pending batch) rather than 2 pairings per share.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..crypto import threshold_sig as ts
+from . import messages as M
+from .protocol import Broadcaster, Protocol
+
+
+class CommonCoin(Protocol):
+    def __init__(
+        self,
+        pid: M.CoinId,
+        broadcaster: Broadcaster,
+        key_share: ts.TsPrivateKeyShare,
+        pub_key_set: ts.TsPublicKeySet,
+    ):
+        super().__init__(pid, broadcaster)
+        self._signer = ts.ThresholdSigner(pid.to_bytes(), key_share, pub_key_set)
+        self._requested = False
+        self._done = False
+
+    def handle_input(self, value) -> None:
+        if self._requested:
+            return
+        self._requested = True
+        my_share = self._signer.sign()
+        self.broadcaster.broadcast(
+            M.CoinMessage(coin=self.id, share=my_share.to_bytes())
+        )
+        # my own share counts immediately
+        self._add(my_share)
+
+    def handle_external(self, sender: int, payload) -> None:
+        if not isinstance(payload, M.CoinMessage):
+            raise TypeError(f"unexpected payload {type(payload)}")
+        try:
+            share = ts.PartialSignature.from_bytes(payload.share)
+        except (ValueError, AssertionError):
+            return  # malformed share: drop (byzantine sender)
+        if share.signer_id != sender:
+            return  # equivocation attempt: share must be the sender's own
+        self._add(share)
+
+    def _add(self, share: ts.PartialSignature) -> None:
+        if self._done:
+            return
+        # deferred verification: shares are accepted unverified; the signer
+        # checks the COMBINED signature (2 pairings total) and only falls back
+        # to the RLC batch verifier to prune bad shares when that check fails
+        # — this is the batched path the module docstring promises.
+        self._signer.add_share(share, verify=False)
+        sig = self._signer.signature
+        if sig is not None:
+            self._done = True
+            self.emit_result(sig.parity)

@@ -1,0 +1,159 @@
+"""Era router: creates protocol instances on demand and routes envelopes.
+
+Parity with the reference's EraBroadcaster
+(/root/reference/src/Lachain.Core/Consensus/EraBroadcaster.cs):
+  * one protocol instance per id, created on first reference (344-410)
+  * external payload -> protocol id routing (135-194)
+  * id validation / spam defense: era must match, indices in range (418-529)
+  * terminated protocols drop further traffic
+  * Request/Result plumbing between parents and children (229-301)
+
+This object is synchronous and deterministic: the delivery layer (simulator
+or network runtime) decides WHEN dispatch() runs; the router only decides
+WHERE an envelope goes. Outbound messages are emitted through a transport
+callback, so the same router serves the in-process simulator and the real
+node.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from . import messages as M
+from .binary_agreement import BinaryAgreement
+from .binary_broadcast import BinaryBroadcast
+from .common_coin import CommonCoin
+from .common_subset import CommonSubset
+from .honey_badger import HoneyBadger
+from .keys import PrivateConsensusKeys, PublicConsensusKeys
+from .protocol import Broadcaster, Protocol
+from .reliable_broadcast import ReliableBroadcast
+
+logger = logging.getLogger("lachain.consensus.era")
+
+
+class EraRouter(Broadcaster):
+    def __init__(
+        self,
+        era: int,
+        my_id: int,
+        public_keys: PublicConsensusKeys,
+        private_keys: PrivateConsensusKeys,
+        send: Callable[[Optional[int], Any], None],
+        extra_factories: Optional[Dict[type, Callable]] = None,
+    ):
+        """`send(target, payload)`: target None = broadcast to all validators
+        (including self-delivery handled by the transport)."""
+        self.era = era
+        self._my_id = my_id
+        self.public_keys = public_keys
+        self.private_keys = private_keys
+        self._send = send
+        self._protocols: Dict[Any, Protocol] = {}
+        self._extra_factories = extra_factories or {}
+        self.terminated = False
+
+    # -- Broadcaster interface ----------------------------------------------
+    @property
+    def my_id(self) -> int:
+        return self._my_id
+
+    @property
+    def n_validators(self) -> int:
+        return self.public_keys.n
+
+    @property
+    def f(self) -> int:
+        return self.public_keys.f
+
+    def broadcast(self, payload) -> None:
+        self._send(None, payload)
+
+    def send_to(self, validator: int, payload) -> None:
+        self._send(validator, payload)
+
+    def internal_request(self, req: M.Request) -> None:
+        proto = self._ensure_protocol(req.to_id)
+        if proto is not None:
+            proto.receive(req)
+
+    def internal_response(self, res: M.Result) -> None:
+        if res.to_id is None:
+            return  # top-level protocol: result observed via .result
+        proto = self._protocols.get(res.to_id)
+        if proto is not None:
+            proto.receive(res)
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch_external(self, sender: int, payload) -> None:
+        """Route a validator's payload to its protocol (creating it)."""
+        if self.terminated:
+            return
+        try:
+            pid = M.payload_protocol_id(payload)
+        except TypeError:
+            logger.warning("unroutable payload from %d", sender)
+            return
+        if not self._validate_id(pid):
+            logger.warning("invalid protocol id %s from %d", pid, sender)
+            return
+        proto = self._ensure_protocol(pid)
+        if proto is not None:
+            proto.receive(M.External(sender=sender, payload=payload))
+
+    def result_of(self, pid) -> Any:
+        proto = self._protocols.get(pid)
+        return proto.result if proto else None
+
+    def protocol(self, pid) -> Optional[Protocol]:
+        return self._protocols.get(pid)
+
+    # -- validation (EraBroadcaster.cs:418-529) -------------------------------
+    def _validate_id(self, pid) -> bool:
+        if getattr(pid, "era", None) != self.era:
+            return False
+        n = self.n_validators
+        if isinstance(pid, M.ReliableBroadcastId):
+            return 0 <= pid.sender_id < n
+        if isinstance(pid, (M.BinaryAgreementId,)):
+            return 0 <= pid.agreement < n
+        if isinstance(pid, (M.BinaryBroadcastId, M.CoinId)):
+            ok = 0 <= pid.agreement < n or pid.agreement == -1
+            return ok and pid.epoch >= 0
+        return True
+
+    # -- factory (EraBroadcaster.CreateProtocol, 361-410) ---------------------
+    def _ensure_protocol(self, pid) -> Optional[Protocol]:
+        proto = self._protocols.get(pid)
+        if proto is not None:
+            return None if proto.terminated else proto
+        proto = self._create(pid)
+        if proto is None:
+            logger.warning("no factory for protocol id %s", pid)
+            return None
+        self._protocols[pid] = proto
+        return proto
+
+    def _create(self, pid) -> Optional[Protocol]:
+        if type(pid) in self._extra_factories:
+            return self._extra_factories[type(pid)](pid, self)
+        if isinstance(pid, M.BinaryBroadcastId):
+            return BinaryBroadcast(pid, self)
+        if isinstance(pid, M.CoinId):
+            return CommonCoin(
+                pid,
+                self,
+                self.private_keys.ts_share,
+                self.public_keys.ts_keys,
+            )
+        if isinstance(pid, M.BinaryAgreementId):
+            return BinaryAgreement(pid, self)
+        if isinstance(pid, M.ReliableBroadcastId):
+            return ReliableBroadcast(pid, self)
+        if isinstance(pid, M.CommonSubsetId):
+            return CommonSubset(pid, self)
+        if isinstance(pid, M.HoneyBadgerId):
+            return HoneyBadger(
+                pid, self, self.public_keys, self.private_keys
+            )
+        return None

@@ -1,0 +1,78 @@
+"""Era key sets for consensus.
+
+Parity with the reference's key-set seam (SURVEY.md §1 "dependency seam"):
+  * PublicConsensusKeys  ~ IPublicConsensusKeySet
+    (/root/reference/src/Lachain.Consensus/PublicConsensusKeySet.cs:10-63)
+  * PrivateConsensusKeys ~ PrivateConsensusKeySet.cs
+
+Every protocol receives these via its Broadcaster; swapping the crypto
+backend (python / native C++ / TPU-batched) never touches protocol code.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from ..crypto import tpke
+from ..crypto import threshold_sig as ts
+
+
+@dataclass
+class PublicConsensusKeys:
+    n: int
+    f: int
+    tpke_pub: tpke.TpkePublicKey
+    tpke_verification_keys: List[tpke.TpkeVerificationKey]  # per validator
+    ts_keys: ts.TsPublicKeySet
+    ecdsa_pub_keys: List[bytes]  # per-validator ECDSA public keys (compressed)
+
+    def __post_init__(self):
+        assert self.n > 3 * self.f or self.f == 0
+        assert len(self.tpke_verification_keys) == self.n
+        assert self.ts_keys.n == self.n
+
+
+@dataclass
+class PrivateConsensusKeys:
+    tpke_priv: tpke.TpkePrivateKey
+    ts_share: ts.TsPrivateKeyShare
+    ecdsa_priv: Optional[bytes] = None
+
+
+def trusted_key_gen(n: int, f: int, rng=None):
+    """Dealer for devnets/tests: returns (public_keys, [private_keys per i]).
+
+    Reference counterpart: Console/TrustedKeygen + the per-test dealers
+    (test/Lachain.ConsensusTest/HoneyBadgerTest.cs:40-53).
+    """
+    import secrets as _secrets
+
+    rng = rng or _secrets
+    tp = tpke.TpkeTrustedKeyGen(n, f, rng=rng)
+    tsd = ts.TsTrustedKeyGen(n, f, rng=rng)
+    from ..crypto import ecdsa as ec
+
+    priv_list = []
+    ecdsa_pubs = []
+    ecdsa_privs = []
+    for i in range(n):
+        sk = ec.generate_private_key(rng)
+        ecdsa_privs.append(sk)
+        ecdsa_pubs.append(ec.public_key_bytes(sk))
+    pub = PublicConsensusKeys(
+        n=n,
+        f=f,
+        tpke_pub=tp.pub,
+        tpke_verification_keys=list(tp.verification_keys),
+        ts_keys=tsd.pub_key_set,
+        ecdsa_pub_keys=ecdsa_pubs,
+    )
+    for i in range(n):
+        priv_list.append(
+            PrivateConsensusKeys(
+                tpke_priv=tp.private_key(i),
+                ts_share=tsd.private_key_share(i),
+                ecdsa_priv=ecdsa_privs[i],
+            )
+        )
+    return pub, priv_list

@@ -1,0 +1,121 @@
+"""Deterministic in-process multi-validator simulator with adversarial
+delivery.
+
+Parity with the reference's test harness (SURVEY.md §4.1):
+  * DeliveryService w/ TAKE_FIRST / TAKE_LAST / TAKE_RANDOM reordering and
+    duplicate injection (test/Lachain.ConsensusTest/DeliverySerivce.cs:10-124)
+  * BroadcastSimulator auto-instantiating protocols
+    (BroadcastSimulator.cs:16-225)
+  * muted ("crashed") players (DeliverySerivce.cs:45-48)
+
+Unlike the reference's thread-based router, delivery here is a single seeded
+loop: identical seeds replay identical executions, including adversarial
+reorderings — the determinism requirement called out in SURVEY.md §7
+("hard parts" #3).
+"""
+from __future__ import annotations
+
+import enum
+import random
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from . import messages as M
+from .era import EraRouter
+from .keys import PrivateConsensusKeys, PublicConsensusKeys
+
+
+class DeliveryMode(enum.Enum):
+    TAKE_FIRST = "first"
+    TAKE_LAST = "last"
+    TAKE_RANDOM = "random"
+
+
+class SimulatedNetwork:
+    """N validators, one EraRouter each, a shared adversarial delivery queue."""
+
+    def __init__(
+        self,
+        public_keys: PublicConsensusKeys,
+        private_keys: List[PrivateConsensusKeys],
+        era: int = 0,
+        seed: int = 0,
+        mode: DeliveryMode = DeliveryMode.TAKE_FIRST,
+        repeat_probability: float = 0.0,
+        muted: Optional[Set[int]] = None,
+        extra_factories: Optional[Dict[type, Callable]] = None,
+        router_cls=EraRouter,
+    ):
+        self.n = public_keys.n
+        self.rng = random.Random(seed)
+        self.mode = mode
+        self.repeat_probability = repeat_probability
+        self.muted = muted or set()
+        self._queue: List[Tuple[int, int, Any]] = []  # (sender, target, payload)
+        self.routers: List[EraRouter] = []
+        for i in range(self.n):
+            self.routers.append(
+                router_cls(
+                    era=era,
+                    my_id=i,
+                    public_keys=public_keys,
+                    private_keys=private_keys[i],
+                    send=self._make_send(i),
+                    extra_factories=extra_factories,
+                )
+            )
+        self.delivered_count = 0
+
+    def _make_send(self, sender: int):
+        def send(target: Optional[int], payload) -> None:
+            if sender in self.muted:
+                return  # crashed player: no outbound traffic
+            if target is None:
+                for t in range(self.n):
+                    self._queue.append((sender, t, payload))
+            else:
+                self._queue.append((sender, target, payload))
+
+        return send
+
+    # -- adversarial queue ----------------------------------------------------
+    def _pop(self) -> Tuple[int, int, Any]:
+        if self.mode is DeliveryMode.TAKE_FIRST:
+            idx = 0
+        elif self.mode is DeliveryMode.TAKE_LAST:
+            idx = len(self._queue) - 1
+        else:
+            idx = self.rng.randrange(len(self._queue))
+        item = self._queue.pop(idx)
+        if self.repeat_probability > 0 and self.rng.random() < self.repeat_probability:
+            self._queue.append(item)  # duplicate injection
+        return item
+
+    # -- execution ------------------------------------------------------------
+    def post_request(self, validator: int, pid, value) -> None:
+        """Inject a top-level ProtocolRequest into one validator."""
+        self.routers[validator].internal_request(
+            M.Request(from_id=None, to_id=pid, input=value)
+        )
+
+    def run(
+        self,
+        done: Callable[[], bool],
+        max_messages: int = 1_000_000,
+    ) -> bool:
+        """Deliver until `done()` or quiescence/cap. True iff done() held."""
+        while not done():
+            if not self._queue:
+                return done()
+            if self.delivered_count >= max_messages:
+                raise RuntimeError(
+                    f"message cap {max_messages} exceeded — livelock?"
+                )
+            sender, target, payload = self._pop()
+            self.delivered_count += 1
+            if target in self.muted:
+                continue  # crashed player: no inbound processing either
+            self.routers[target].dispatch_external(sender, payload)
+        return True
+
+    def results(self, pid) -> List[Any]:
+        return [r.result_of(pid) for r in self.routers]

@@ -1,0 +1,195 @@
+"""secp256k1 ECDSA: sign / verify / recover, RFC 6979 deterministic nonces.
+
+Parity with the reference's ECDSA surface
+(/root/reference/src/Lachain.Crypto/DefaultCrypto.cs:17-337 over
+Secp256k1.Net): transaction + consensus-header signatures with public-key
+recovery, 65-byte (r || s || v) signatures, Ethereum-style addresses.
+
+Pure Python (curve ops on ints). Not constant-time — acceptable for a
+devnet node signing its own public messages; the native C++ port is the
+hardening path (tracked for a later round alongside batch ECDSA recovery,
+the "vmapped TransactionVerifier" candidate from SURVEY.md §2a).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional, Tuple
+
+from .hashes import keccak256
+
+# secp256k1 domain parameters
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+G = (GX, GY)
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _add(p: Optional[Tuple[int, int]], q: Optional[Tuple[int, int]]):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _mul(p: Optional[Tuple[int, int]], k: int):
+    k %= N
+    result = None
+    addend = p
+    while k:
+        if k & 1:
+            result = _add(result, addend)
+        addend = _add(addend, addend)
+        k >>= 1
+    return result
+
+
+def generate_private_key(rng=None) -> bytes:
+    import secrets as _secrets
+
+    rng = rng or _secrets
+    while True:
+        k = rng.randbelow(N)
+        if 1 <= k < N:
+            return k.to_bytes(32, "big")
+
+
+def public_key_point(priv: bytes) -> Tuple[int, int]:
+    return _mul(G, int.from_bytes(priv, "big"))
+
+
+def public_key_bytes(priv: bytes) -> bytes:
+    """Compressed SEC1 encoding (33 bytes)."""
+    x, y = public_key_point(priv)
+    return bytes([0x02 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def decompress_public_key(pub: bytes) -> Tuple[int, int]:
+    assert len(pub) == 33 and pub[0] in (2, 3)
+    x = int.from_bytes(pub[1:], "big")
+    if x >= P:
+        raise ValueError("pubkey x out of range")
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("pubkey not on curve")
+    if (y & 1) != (pub[0] & 1):
+        y = P - y
+    return (x, y)
+
+
+def address_from_public_key(pub: bytes) -> bytes:
+    """20-byte Ethereum-style address: keccak256(uncompressed_xy)[12:]."""
+    x, y = decompress_public_key(pub) if len(pub) == 33 else (
+        int.from_bytes(pub[1:33], "big"),
+        int.from_bytes(pub[33:], "big"),
+    )
+    raw = x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    return keccak256(raw)[12:]
+
+
+def _rfc6979_k(priv: bytes, msg_hash: bytes) -> int:
+    """Deterministic nonce per RFC 6979 (HMAC-SHA256)."""
+    holder = b"\x01" * 32
+    key = b"\x00" * 32
+    key = hmac.new(key, holder + b"\x00" + priv + msg_hash, hashlib.sha256).digest()
+    holder = hmac.new(key, holder, hashlib.sha256).digest()
+    key = hmac.new(key, holder + b"\x01" + priv + msg_hash, hashlib.sha256).digest()
+    holder = hmac.new(key, holder, hashlib.sha256).digest()
+    while True:
+        holder = hmac.new(key, holder, hashlib.sha256).digest()
+        k = int.from_bytes(holder, "big")
+        if 1 <= k < N:
+            return k
+        key = hmac.new(key, holder + b"\x00", hashlib.sha256).digest()
+        holder = hmac.new(key, holder, hashlib.sha256).digest()
+
+
+def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
+    """65-byte recoverable signature r(32) || s(32) || v(1), low-s enforced."""
+    assert len(msg_hash) == 32
+    z = int.from_bytes(msg_hash, "big") % N
+    d = int.from_bytes(priv, "big")
+    extra = b""
+    while True:
+        # r == 0 / s == 0 are ~2^-256 events; retry with a tweaked nonce
+        # stream while keeping z bound to the ORIGINAL message hash.
+        k = _rfc6979_k(priv, hashlib.sha256(msg_hash + extra).digest() if extra else msg_hash)
+        pt = _mul(G, k)
+        r = pt[0] % N
+        if r == 0:
+            extra += b"\x00"
+            continue
+        s = _inv(k, N) * (z + r * d) % N
+        if s == 0:
+            extra += b"\x00"
+            continue
+        v = (pt[1] & 1) | (2 if pt[0] >= N else 0)
+        if s > N // 2:  # low-s normalization flips the parity bit
+            s = N - s
+            v ^= 1
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+
+
+def verify_hash(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+    if len(sig) != 65:
+        return False
+    try:
+        q = decompress_public_key(pub)
+    except ValueError:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    z = int.from_bytes(msg_hash, "big") % N
+    w = _inv(s, N)
+    u1 = z * w % N
+    u2 = r * w % N
+    pt = _add(_mul(G, u1), _mul(q, u2))
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
+    """Recover the compressed public key from a 65-byte signature."""
+    if len(sig) != 65:
+        return None
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    v = sig[64]
+    if not (1 <= r < N and 1 <= s < N) or v > 3:
+        return None
+    x = r + (N if v & 2 else 0)
+    if x >= P:
+        return None
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        return None
+    if (y & 1) != (v & 1):
+        y = P - y
+    rp = (x, y)
+    z = int.from_bytes(msg_hash, "big") % N
+    rinv = _inv(r, N)
+    q = _mul(_add(_mul(rp, s), _mul(G, N - z)), rinv)
+    if q is None:
+        return None
+    return bytes([0x02 | (q[1] & 1)]) + q[0].to_bytes(32, "big")

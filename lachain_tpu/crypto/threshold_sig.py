@@ -92,7 +92,7 @@ class TsPublicKey:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "TsPublicKey":
-        return cls(bls.g1_from_bytes(data))
+        return cls(get_backend().g1_deserialize(data))
 
     def verify(self, msg: bytes, sig: Signature) -> bool:
         """e(g1, sigma) == e(Y, H_G2(msg))
@@ -168,7 +168,8 @@ class TsPublicKeySet:
         backend = get_backend()
 
         def group_ok(idx: List[int]) -> bool:
-            cs = [rng.randbelow(1 << 128) + 1 for _ in idx]
+            # < 2^128 so the TPU path's 128-bit encoding is exact
+            cs = [rng.randbelow((1 << 128) - 1) + 1 for _ in idx]
             sig_agg = backend.g2_msm(
                 [shares[live[i]].sigma for i in idx], cs
             )

@@ -134,7 +134,7 @@ class TpkePublicKey:
     def from_bytes(cls, data: bytes) -> "TpkePublicKey":
         from ..utils.serialization import Reader
 
-        y = bls.g1_from_bytes(data[: bls.G1_BYTES])
+        y = get_backend().g1_deserialize(data[: bls.G1_BYTES])
         r = Reader(data[bls.G1_BYTES :])
         t = r.u32()
         r.assert_eof()
@@ -192,7 +192,9 @@ class TpkePublicKey:
         backend = get_backend()
 
         def group_ok(idx: List[int]) -> bool:
-            cs = [rng.randbelow(1 << 128) + 1 for _ in idx]
+            # coefficients strictly below 2^128 so the TPU path's 128-bit
+            # scalar encoding (ops/verify.py) represents them exactly
+            cs = [rng.randbelow((1 << 128) - 1) + 1 for _ in idx]
             u_agg = backend.g1_msm([decs[i].ui for i in idx], cs)
             y_agg = backend.g1_msm([vks[i].y_i for i in idx], cs)
             return backend.pairing_check(
@@ -237,7 +239,7 @@ class TpkeVerificationKey:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "TpkeVerificationKey":
-        return cls(bls.g1_from_bytes(data))
+        return cls(get_backend().g1_deserialize(data))
 
 
 class TpkePrivateKey:

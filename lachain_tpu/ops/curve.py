@@ -1,0 +1,352 @@
+"""Batched BLS12-381 G1 point arithmetic + MSM in JAX.
+
+The TPU hot path of the framework: where the reference verifies decryption /
+signature shares one at a time with 2 pairings each
+(/root/reference/src/Lachain.Crypto/TPKE/PublicKey.cs:88-92,
+ThresholdSignature/ThresholdSigner.cs:45-95), lachain-tpu reduces a whole
+share batch to multi-scalar multiplications (see crypto/tpke.py
+batch_verify_shares) and runs THOSE here, batched over the share axis.
+
+Representation: Jacobian (X, Y, Z) with each coordinate a 32x12-bit Montgomery
+limb vector (ops/fp.py); a point is an int32 array (..., 3, NLIMBS). Z == 0
+encodes infinity. The group law is branchless: generic-add, doubling and
+infinity cases are all computed and merged with jnp.where, so the same traced
+program serves every input — the XLA-friendly equivalent of the branchy
+Jacobian add in the native backend (bls381.cpp g1_add).
+
+Fp2/G2 batched arithmetic: same design, components stacked on an extra axis
+(..., 2, NLIMBS); G2 points are (..., 3, 2, NLIMBS).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp
+from ..crypto import bls12381 as bls
+
+# ---------------------------------------------------------------------------
+# host <-> device point conversion
+# ---------------------------------------------------------------------------
+
+
+def g1_to_device(points) -> np.ndarray:
+    """List of oracle G1 Jacobian tuples -> (n, 3, NLIMBS) Montgomery array."""
+    out = np.zeros((len(points), 3, fp.NLIMBS), dtype=np.int32)
+    for i, pt in enumerate(points):
+        aff = bls.g1_to_affine(pt)
+        if aff is None:
+            out[i, 1] = fp.to_mont_host(1)  # (0, 1, 0) = infinity
+        else:
+            out[i, 0] = fp.to_mont_host(aff[0])
+            out[i, 1] = fp.to_mont_host(aff[1])
+            out[i, 2] = fp.to_mont_host(1)
+    return out
+
+
+def g1_from_device(arr) -> list:
+    """(n, 3, NLIMBS) -> list of oracle G1 tuples."""
+    arr = np.asarray(arr)
+    out = []
+    for i in range(arr.shape[0]):
+        x = fp.from_mont_host(arr[i, 0])
+        y = fp.from_mont_host(arr[i, 1])
+        z = fp.from_mont_host(arr[i, 2])
+        out.append((x, y, z))
+    return out
+
+
+def scalars_to_bits(scalars, nbits: int = 256) -> np.ndarray:
+    """List of ints -> (n, nbits) int32 bit matrix, MSB first."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for b in range(nbits):
+            out[i, b] = (s >> (nbits - 1 - b)) & 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batched group law
+# ---------------------------------------------------------------------------
+
+
+def g1_inf_like(p):
+    """Infinity point(s) with the same batch shape as p."""
+    x = jnp.zeros_like(p[..., 0, :])
+    y = jnp.broadcast_to(fp.ONE_MONT, p[..., 1, :].shape)
+    z = jnp.zeros_like(p[..., 2, :])
+    return jnp.stack([x, y, z], axis=-2)
+
+
+def g1_is_inf(p):
+    return fp.is_zero(p[..., 2, :])
+
+
+def g1_dbl(p):
+    X1, Y1, Z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    A = fp.mont_sqr(X1)
+    B = fp.mont_sqr(Y1)
+    C = fp.mont_sqr(B)
+    t = fp.add(X1, B)
+    D = fp.sub(fp.sub(fp.mont_sqr(t), A), C)
+    D = fp.add(D, D)
+    E = fp.add(fp.add(A, A), A)
+    F = fp.mont_sqr(E)
+    X3 = fp.sub(F, fp.add(D, D))
+    C8 = fp.add(C, C)
+    C8 = fp.add(C8, C8)
+    C8 = fp.add(C8, C8)
+    Y3 = fp.sub(fp.mont_mul(E, fp.sub(D, X3)), C8)
+    Z3 = fp.mont_mul(Y1, Z1)
+    Z3 = fp.add(Z3, Z3)
+    res = jnp.stack([X3, Y3, Z3], axis=-2)
+    # doubling a point with Y == 0 or infinity -> infinity
+    bad = g1_is_inf(p) | fp.is_zero(Y1)
+    return jnp.where(bad[..., None, None], g1_inf_like(p), res)
+
+
+def g1_add(p, q):
+    """Branchless complete-ish Jacobian addition (handles inf, equal, neg)."""
+    X1, Y1, Z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    X2, Y2, Z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    Z1Z1 = fp.mont_sqr(Z1)
+    Z2Z2 = fp.mont_sqr(Z2)
+    U1 = fp.mont_mul(X1, Z2Z2)
+    U2 = fp.mont_mul(X2, Z1Z1)
+    S1 = fp.mont_mul(fp.mont_mul(Y1, Z2), Z2Z2)
+    S2 = fp.mont_mul(fp.mont_mul(Y2, Z1), Z1Z1)
+    H = fp.sub(U2, U1)
+    Rr = fp.sub(S2, S1)
+    same_x = fp.is_zero(H)
+    same_y = fp.is_zero(Rr)
+
+    I = fp.mont_sqr(fp.add(H, H))
+    J = fp.mont_mul(H, I)
+    Rr2 = fp.add(Rr, Rr)
+    V = fp.mont_mul(U1, I)
+    X3 = fp.sub(fp.sub(fp.mont_sqr(Rr2), J), fp.add(V, V))
+    S1J = fp.mont_mul(S1, J)
+    Y3 = fp.sub(
+        fp.mont_mul(Rr2, fp.sub(V, X3)), fp.add(S1J, S1J)
+    )
+    Z3 = fp.mont_mul(fp.mont_mul(Z1, Z2), H)
+    Z3 = fp.add(Z3, Z3)
+    generic = jnp.stack([X3, Y3, Z3], axis=-2)
+
+    dbl = g1_dbl(p)
+    inf = g1_inf_like(p)
+    res = jnp.where(
+        same_x[..., None, None],
+        jnp.where(same_y[..., None, None], dbl, inf),
+        generic,
+    )
+    res = jnp.where(g1_is_inf(q)[..., None, None], p, res)
+    res = jnp.where(g1_is_inf(p)[..., None, None], jnp.broadcast_to(q, res.shape), res)
+    return res
+
+
+def g1_scalar_mul_bits(points, bits):
+    """Batched double-and-add: points (..., 3, L), bits (..., nbits) MSB-first.
+
+    lax.scan over the bit axis — static trip count, branchless body.
+    """
+    nbits = bits.shape[-1]
+    acc0 = g1_inf_like(points)
+
+    def step(acc, i):
+        acc = g1_dbl(acc)
+        with_add = g1_add(acc, points)
+        bit = bits[..., i]
+        acc = jnp.where(bit[..., None, None] == 1, with_add, acc)
+        return acc, None
+
+    acc, _ = lax.scan(step, acc0, jnp.arange(nbits))
+    return acc
+
+
+def g1_reduce_sum(points):
+    """Tree-reduce a batch of points (n, 3, L) -> (3, L) via g1_add.
+
+    n must be a power of two (pad with infinity host-side).
+    """
+    n = points.shape[0]
+    assert n & (n - 1) == 0, "g1_reduce_sum needs a power-of-two batch"
+    while n > 1:
+        half = n // 2
+        points = g1_add(points[:half], points[half:n])
+        n = half
+    return points[0]
+
+
+def g1_msm(points, bits):
+    """Full MSM: batched scalar-mul then tree reduction -> single point."""
+    return g1_reduce_sum(g1_scalar_mul_bits(points, bits))
+
+
+# ---------------------------------------------------------------------------
+# Fp2 / G2 — component-stacked on axis -2 of the limb pair
+# ---------------------------------------------------------------------------
+
+
+def fp2_add(a, b):
+    return jnp.stack(
+        [fp.add(a[..., 0, :], b[..., 0, :]), fp.add(a[..., 1, :], b[..., 1, :])],
+        axis=-2,
+    )
+
+
+def fp2_sub(a, b):
+    return jnp.stack(
+        [fp.sub(a[..., 0, :], b[..., 0, :]), fp.sub(a[..., 1, :], b[..., 1, :])],
+        axis=-2,
+    )
+
+
+def fp2_mul(a, b):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fp.mont_mul(a0, b0)
+    t1 = fp.mont_mul(a1, b1)
+    t2 = fp.mont_mul(fp.add(a0, a1), fp.add(b0, b1))
+    return jnp.stack(
+        [fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1)], axis=-2
+    )
+
+
+def fp2_sqr(a):
+    return fp2_mul(a, a)
+
+
+def fp2_is_zero(a):
+    return fp.is_zero(a[..., 0, :]) & fp.is_zero(a[..., 1, :])
+
+
+def g2_to_device(points) -> np.ndarray:
+    out = np.zeros((len(points), 3, 2, fp.NLIMBS), dtype=np.int32)
+    for i, pt in enumerate(points):
+        aff = bls.g2_to_affine(pt)
+        if aff is None:
+            out[i, 1, 0] = fp.to_mont_host(1)
+        else:
+            (x0, x1), (y0, y1) = aff
+            out[i, 0, 0] = fp.to_mont_host(x0)
+            out[i, 0, 1] = fp.to_mont_host(x1)
+            out[i, 1, 0] = fp.to_mont_host(y0)
+            out[i, 1, 1] = fp.to_mont_host(y1)
+            out[i, 2, 0] = fp.to_mont_host(1)
+    return out
+
+
+def g2_from_device(arr) -> list:
+    arr = np.asarray(arr)
+    out = []
+    for i in range(arr.shape[0]):
+        coords = []
+        for c in range(3):
+            coords.append(
+                (
+                    fp.from_mont_host(arr[i, c, 0]),
+                    fp.from_mont_host(arr[i, c, 1]),
+                )
+            )
+        out.append(tuple(coords))
+    return out
+
+
+def g2_inf_like(p):
+    res = jnp.zeros_like(p)
+    one = jnp.broadcast_to(fp.ONE_MONT, p[..., 1, 0, :].shape)
+    return res.at[..., 1, 0, :].set(one)
+
+
+def g2_is_inf(p):
+    return fp2_is_zero(p[..., 2, :, :])
+
+
+def g2_dbl(p):
+    X1, Y1, Z1 = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    A = fp2_sqr(X1)
+    B = fp2_sqr(Y1)
+    C = fp2_sqr(B)
+    D = fp2_sub(fp2_sub(fp2_sqr(fp2_add(X1, B)), A), C)
+    D = fp2_add(D, D)
+    E = fp2_add(fp2_add(A, A), A)
+    F = fp2_sqr(E)
+    X3 = fp2_sub(F, fp2_add(D, D))
+    C8 = fp2_add(C, C)
+    C8 = fp2_add(C8, C8)
+    C8 = fp2_add(C8, C8)
+    Y3 = fp2_sub(fp2_mul(E, fp2_sub(D, X3)), C8)
+    Z3 = fp2_mul(Y1, Z1)
+    Z3 = fp2_add(Z3, Z3)
+    res = jnp.stack([X3, Y3, Z3], axis=-3)
+    bad = g2_is_inf(p) | fp2_is_zero(Y1)
+    return jnp.where(bad[..., None, None, None], g2_inf_like(p), res)
+
+
+def g2_add(p, q):
+    X1, Y1, Z1 = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    X2, Y2, Z2 = q[..., 0, :, :], q[..., 1, :, :], q[..., 2, :, :]
+    Z1Z1 = fp2_sqr(Z1)
+    Z2Z2 = fp2_sqr(Z2)
+    U1 = fp2_mul(X1, Z2Z2)
+    U2 = fp2_mul(X2, Z1Z1)
+    S1 = fp2_mul(fp2_mul(Y1, Z2), Z2Z2)
+    S2 = fp2_mul(fp2_mul(Y2, Z1), Z1Z1)
+    H = fp2_sub(U2, U1)
+    Rr = fp2_sub(S2, S1)
+    same_x = fp2_is_zero(H)
+    same_y = fp2_is_zero(Rr)
+    I = fp2_sqr(fp2_add(H, H))
+    J = fp2_mul(H, I)
+    Rr2 = fp2_add(Rr, Rr)
+    V = fp2_mul(U1, I)
+    X3 = fp2_sub(fp2_sub(fp2_sqr(Rr2), J), fp2_add(V, V))
+    S1J = fp2_mul(S1, J)
+    Y3 = fp2_sub(fp2_mul(Rr2, fp2_sub(V, X3)), fp2_add(S1J, S1J))
+    Z3 = fp2_mul(fp2_mul(Z1, Z2), H)
+    Z3 = fp2_add(Z3, Z3)
+    generic = jnp.stack([X3, Y3, Z3], axis=-3)
+    dbl = g2_dbl(p)
+    inf = g2_inf_like(p)
+    res = jnp.where(
+        same_x[..., None, None, None],
+        jnp.where(same_y[..., None, None, None], dbl, inf),
+        generic,
+    )
+    res = jnp.where(g2_is_inf(q)[..., None, None, None], p, res)
+    res = jnp.where(
+        g2_is_inf(p)[..., None, None, None], jnp.broadcast_to(q, res.shape), res
+    )
+    return res
+
+
+def g2_scalar_mul_bits(points, bits):
+    acc0 = g2_inf_like(points)
+
+    def step(acc, i):
+        acc = g2_dbl(acc)
+        with_add = g2_add(acc, points)
+        bit = bits[..., i]
+        acc = jnp.where(bit[..., None, None, None] == 1, with_add, acc)
+        return acc, None
+
+    acc, _ = lax.scan(step, acc0, jnp.arange(bits.shape[-1]))
+    return acc
+
+
+def g2_reduce_sum(points):
+    n = points.shape[0]
+    assert n & (n - 1) == 0
+    while n > 1:
+        half = n // 2
+        points = g2_add(points[:half], points[half:n])
+        n = half
+    return points[0]
+
+
+def g2_msm(points, bits):
+    return g2_reduce_sum(g2_scalar_mul_bits(points, bits))

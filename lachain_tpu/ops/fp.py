@@ -1,0 +1,208 @@
+"""Batched BLS12-381 Fp arithmetic in JAX — multi-limb Montgomery form.
+
+This is the device-side mirror of the native backend's 6x64 Montgomery field
+(lachain_tpu/crypto/native/bls381.cpp) re-designed for the TPU's integer VPU:
+
+  * An Fp element is 32 limbs x 12 bits stored as int32, trailing axis of
+    shape (..., 32). 12-bit limbs keep every intermediate product sum strictly
+    below 2^31: conv products are <= 32 * (2^12-1)^2 < 2^29 and the CIOS
+    accumulators stay < 2^30, so no int64 (which TPUs lack natively) is ever
+    needed.
+  * All functions are shape-polymorphic over leading batch axes and contain
+    only static control flow (unrolled Python loops over the 32 limb
+    positions), so they trace once under jit/vmap/shard_map.
+  * Elements live in Montgomery form (x * 2^384 mod p) on device; conversion
+    happens host-side in io.py helpers.
+
+Reference role: the Fr/Fp tower underneath MCL's G1/G2 in the reference
+(/root/reference/src/Lachain.Crypto/MclBls12381.cs) — here batch-first because
+the consensus hot path verifies N x N shares per era (SURVEY.md §5
+"long-context / sequence parallelism" maps to exactly this batch axis).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto import bls12381 as bls
+
+NLIMBS = 32
+BASE = 12
+MASK = (1 << BASE) - 1
+NBITS = NLIMBS * BASE  # 384
+
+P_INT = bls.P
+R_MONT = (1 << NBITS) % P_INT
+R2_INT = R_MONT * R_MONT % P_INT
+PINV12 = (-pow(P_INT, -1, 1 << BASE)) % (1 << BASE)
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    return np.array(
+        [(v >> (BASE * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+    )
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a)
+    return sum(int(a[i]) << (BASE * i) for i in range(NLIMBS))
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P_INT))
+# 2^384 - p, 33 limbs — used for the "add and check carry-out" >= p test.
+NEG_P_LIMBS_33 = jnp.asarray(
+    np.array(
+        [((1 << NBITS) - P_INT >> (BASE * i)) & MASK for i in range(NLIMBS + 1)],
+        dtype=np.int32,
+    )
+)
+ONE_MONT = jnp.asarray(int_to_limbs(R_MONT))
+ZERO = jnp.asarray(np.zeros(NLIMBS, dtype=np.int32))
+
+
+def _crush(t, rounds: int = 2):
+    """Magnitude reduction: after each round limb magnitudes shrink by ~2^12.
+
+    NOT exact on its own — single +-1 carries can still ripple arbitrarily
+    far (e.g. a value of exactly 2^384 is a 33-limb carry chain). Always
+    followed by _ripple for exactness; _crush only bounds the inputs so the
+    ripple's carries stay in {-1, 0, 1}.
+    """
+    for _ in range(rounds):
+        carry = t >> BASE  # arithmetic shift: handles borrows
+        t = (t & MASK) + jnp.pad(
+            carry[..., :-1], [(0, 0)] * (t.ndim - 1) + [(1, 0)]
+        )
+    return t
+
+
+def _ripple(t):
+    """Exact sequential carry propagation (lax.scan over the limb axis).
+
+    Returns (normalized_limbs, carry_out). Carries/borrows of any length are
+    handled exactly — this fixes the fixed-round propagation flaw where
+    structured values (exactly p, exactly 2^384) produced wrong limbs. A scan
+    keeps the compiled graph tiny (one body for all limb positions).
+    """
+    tt = jnp.moveaxis(t, -1, 0)  # (L, ...batch)
+
+    def step(carry, ti):
+        cur = ti + carry
+        return cur >> BASE, cur & MASK
+
+    carry, outs = lax.scan(
+        step, jnp.zeros(t.shape[:-1], dtype=jnp.int32), tt
+    )
+    return jnp.moveaxis(outs, 0, -1), carry
+
+
+def _cond_sub_p(t):
+    """t normalized limbs with value in [0, 2p) -> t mod p (exact).
+
+    s = t + (2^384 - p) over 33 limbs; carry-out iff t >= p, in which case
+    s mod 2^384 == t - p.
+    """
+    shape = t.shape[:-1]
+    ext = jnp.concatenate(
+        [t, jnp.zeros(shape + (1,), dtype=jnp.int32)], axis=-1
+    )
+    s, _ = _ripple(ext + NEG_P_LIMBS_33)
+    ge = s[..., NLIMBS] > 0
+    return jnp.where(ge[..., None], s[..., :NLIMBS], t)
+
+
+def _reduce2p(t, crush_rounds: int = 2):
+    """Raw limbs with value in [0, 2p) -> canonical [0, p) representation."""
+    t, _ = _ripple(_crush(t, crush_rounds))
+    return _cond_sub_p(t)
+
+
+def normalize(t):
+    """Full normalization of raw limbs (value must be in [0, 2p))."""
+    return _reduce2p(t, crush_rounds=3)
+
+
+def add(x, y):
+    # x, y canonical -> x + y < 2p
+    return _reduce2p(x + y, crush_rounds=1)
+
+
+def sub(x, y):
+    # x - y + p in (0, 2p); arithmetic shifts in crush/ripple absorb borrows
+    return _reduce2p(x - y + P_LIMBS, crush_rounds=1)
+
+
+def neg(x):
+    is_zero_x = is_zero(x)
+    r = sub(jnp.broadcast_to(ZERO, x.shape), x)
+    return jnp.where(is_zero_x[..., None], x, r)
+
+
+def is_zero(x):
+    """x must be normalized (limbs in [0, 2^12), value in [0, p))."""
+    return jnp.all(x == 0, axis=-1)
+
+
+def eq(x, y):
+    return jnp.all(x == y, axis=-1)
+
+
+# one-hot "anti-diagonal sum" matrix: conv(x, y)[k] = sum_{i+j=k} x_i y_j
+# expressed as a single (L*L, 2L) int32 matmul — MXU/VPU-friendly and only a
+# couple of HLO ops instead of L scatter-adds.
+_CONV_ONEHOT = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV_ONEHOT[_i * NLIMBS + _j, _i + _j] = 1
+CONV_ONEHOT = jnp.asarray(_CONV_ONEHOT)
+
+
+def _conv(x, y):
+    """Polynomial product of limb vectors: (..., L) x (..., L) -> (..., 2L).
+
+    Coefficients <= L * (2^12-1)^2 < 2^29 — int32-exact.
+    """
+    outer = x[..., :, None] * y[..., None, :]  # (..., L, L)
+    flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
+    return flat @ CONV_ONEHOT
+
+
+def mont_mul(x, y):
+    """Montgomery product  x*y*2^-384 mod p  (batched, int32-safe).
+
+    One convolution matmul (<=2^29 per coefficient) followed by L CIOS
+    reduction rounds in a lax.scan; every accumulator is provably < 2^31.
+    """
+    x, y = jnp.broadcast_arrays(x, y)
+    t = _conv(x, y)
+
+    def red_step(tt, _):
+        m = ((tt[..., 0] & MASK) * PINV12) & MASK
+        tt = tt.at[..., :NLIMBS].add(m[..., None] * P_LIMBS)
+        carry = tt[..., 0] >> BASE  # low 12 bits are 0 by construction
+        tt = jnp.concatenate(
+            [tt[..., 1:], jnp.zeros_like(tt[..., :1])], axis=-1
+        )
+        tt = tt.at[..., 0].add(carry)
+        return tt, None
+
+    t, _ = lax.scan(red_step, t, None, length=NLIMBS)
+    return _reduce2p(t[..., :NLIMBS], crush_rounds=3)
+
+
+def mont_sqr(x):
+    return mont_mul(x, x)
+
+
+def to_mont_host(v: int) -> np.ndarray:
+    """Host-side: plain int -> Montgomery limb vector."""
+    return int_to_limbs(v * R_MONT % P_INT)
+
+
+def from_mont_host(a) -> int:
+    """Host-side: Montgomery limb vector -> plain int."""
+    rinv = pow(R_MONT, -1, P_INT)
+    return limbs_to_int(np.asarray(a)) * rinv % P_INT

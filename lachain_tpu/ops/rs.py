@@ -1,0 +1,171 @@
+"""GF(2^8) Reed-Solomon erasure coding, vectorized over byte columns.
+
+Parity with the reference's vendored RS codec
+(/root/reference/src/Lachain.Consensus/ReliableBroadcast/ReedSolomon/,
+GenericGF(285, 256, 0) per ErasureCoding.cs:14-16) used by ReliableBroadcast
+to shard payloads (ReliableBroadcast.cs:393-444).
+
+Design: Vandermonde-evaluation Reed-Solomon. A payload is split into K data
+shards; each byte column of the K shards is a degree-(K-1) polynomial's
+coefficient vector, evaluated at N fixed points to produce N code shards.
+Any K received shards reconstruct by interpolation. All per-column work is
+table-lookup + XOR over numpy arrays — the byte-parallel structure the
+reference loops over serially (ReliableBroadcast.cs:408-416) — and is the
+designated second TPU kernel (SURVEY.md §2a): gathers + XOR reductions map
+directly onto vectorized device code.
+
+Field: GF(2^8) with the reference's reduction polynomial x^8+x^4+x^3+x^2+1
+(0x11D = 285).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_POLY = 0x11D
+
+# exp/log tables: generator 2 is primitive for 0x11D.
+_EXP = np.zeros(512, dtype=np.uint8)
+_LOG = np.zeros(256, dtype=np.int32)
+_x = 1
+for _i in range(255):
+    _EXP[_i] = _x
+    _LOG[_x] = _i
+    _x <<= 1
+    if _x & 0x100:
+        _x ^= _POLY
+for _i in range(255, 512):
+    _EXP[_i] = _EXP[_i - 255]
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(_EXP[_LOG[a] + _LOG[b]])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("gf_inv(0)")
+    return int(_EXP[255 - _LOG[a]])
+
+
+def _gf_mul_vec(c: int, v: np.ndarray) -> np.ndarray:
+    """c * v for a scalar c and uint8 vector v."""
+    if c == 0:
+        return np.zeros_like(v)
+    if c == 1:
+        return v.copy()
+    out = np.zeros_like(v)
+    nz = v != 0
+    out[nz] = _EXP[_LOG[c] + _LOG[v[nz]]]
+    return out
+
+
+def _eval_points(n: int) -> List[int]:
+    # x-coordinates 1..n (0 excluded so Vandermonde stays invertible)
+    assert n < 256, "GF(2^8) RS supports at most 255 shards"
+    return list(range(1, n + 1))
+
+
+def encode(data: bytes, k: int, n: int) -> List[bytes]:
+    """Split `data` into k data shards and RS-extend to n total shards.
+
+    Shard layout: data is left-padded with a 4-byte length prefix then
+    zero-padded to k * shard_size; shard j holds coefficient j of each column
+    polynomial. Returns n shards of equal size.
+    """
+    assert 0 < k <= n < 256
+    prefixed = len(data).to_bytes(4, "big") + data
+    shard_size = (len(prefixed) + k - 1) // k
+    padded = prefixed + b"\x00" * (k * shard_size - len(prefixed))
+    coeffs = np.frombuffer(padded, dtype=np.uint8).reshape(k, shard_size)
+    shards = []
+    for x in _eval_points(n):
+        # Horner: p(x) = (...((c_{k-1} x) + c_{k-2}) x + ...) + c_0
+        acc = np.zeros(shard_size, dtype=np.uint8)
+        for j in range(k - 1, -1, -1):
+            acc = _gf_mul_vec(x, acc) ^ coeffs[j]
+        shards.append(acc.tobytes())
+    return shards
+
+
+def decode(shards: Sequence[Optional[bytes]], k: int) -> Optional[bytes]:
+    """Reconstruct the payload from any k non-None shards.
+
+    `shards` is the full n-length list with None for missing entries, in
+    eval-point order. Returns None if fewer than k shards are present or the
+    length prefix is inconsistent.
+    """
+    n = len(shards)
+    have = [(i, s) for i, s in enumerate(shards) if s is not None]
+    if len(have) < k:
+        return None
+    have = have[:k]
+    xs = [_eval_points(n)[i] for i, _ in have]
+    size = len(have[0][1])
+    mat = np.zeros((k, k), dtype=np.uint8)  # Vandermonde rows [x^0 .. x^{k-1}]
+    for r, x in enumerate(xs):
+        v = 1
+        for c in range(k):
+            mat[r, c] = v
+            v = gf_mul(v, x)
+    inv = _gf_mat_inv(mat)
+    if inv is None:
+        return None
+    received = np.stack(
+        [np.frombuffer(s, dtype=np.uint8) for _, s in have]
+    )  # (k, size)
+    coeffs = np.zeros((k, size), dtype=np.uint8)
+    for r in range(k):
+        acc = np.zeros(size, dtype=np.uint8)
+        for c in range(k):
+            acc ^= _gf_mul_vec(int(inv[r, c]), received[c])
+        coeffs[r] = acc
+    flat = coeffs.reshape(-1).tobytes()
+    if len(flat) < 4:
+        return None
+    length = int.from_bytes(flat[:4], "big")
+    if length > len(flat) - 4:
+        return None
+    return flat[4 : 4 + length]
+
+
+def reencode(shards: Sequence[Optional[bytes]], k: int) -> Optional[List[bytes]]:
+    """Reconstruct ALL n shards from any k (for Merkle-root recheck in RBC)."""
+    n = len(shards)
+    payload = decode(shards, k)
+    if payload is None:
+        return None
+    return encode(payload, k, n)
+
+
+def _gf_mat_inv(mat: np.ndarray) -> Optional[np.ndarray]:
+    """Gauss-Jordan inversion over GF(2^8)."""
+    k = mat.shape[0]
+    a = mat.astype(np.int32).copy()
+    inv = np.eye(k, dtype=np.int32)
+    for col in range(k):
+        piv = None
+        for r in range(col, k):
+            if a[r, col] != 0:
+                piv = r
+                break
+        if piv is None:
+            return None
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        pinv = gf_inv(int(a[col, col]))
+        for c in range(k):
+            a[col, c] = gf_mul(int(a[col, c]), pinv)
+            inv[col, c] = gf_mul(int(inv[col, c]), pinv)
+        for r in range(k):
+            if r == col or a[r, col] == 0:
+                continue
+            f = int(a[r, col])
+            for c in range(k):
+                a[r, c] ^= gf_mul(f, int(a[col, c]))
+                inv[r, c] ^= gf_mul(f, int(inv[col, c]))
+    return inv.astype(np.uint8)

@@ -1,0 +1,81 @@
+"""Device-mesh sharding for the batched crypto kernels.
+
+The reference scales consensus crypto by protocol fan-out across OS threads
+(SURVEY.md §2c "parallelism inventory"); the TPU-native equivalent is SPMD
+over a jax.sharding.Mesh: the share axis (N validators x N slots per era) is
+the data axis, sharded across devices with shard_map. Each device computes a
+local MSM over its shard; the partial sums are combined with an all_gather
+followed by a replicated log-tree of point additions (point addition is not
+an elementwise psum-reduction, so the combine rides an explicit collective).
+
+Multi-host scaling: the same mesh spans hosts; XLA routes the all_gather over
+ICI within a pod slice and DCN across slices — this is the framework's
+distributed communication backend for the crypto data plane (SURVEY.md §5
+"Distributed communication backend"). Control-plane consensus messages stay
+on the host network (lachain_tpu/network).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops import curve
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shares") -> Mesh:
+    """1-D mesh over the share/batch axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def sharded_g1_msm(mesh: Mesh, axis: str = "shares"):
+    """Build a jitted MSM over the mesh: points (n,3,L), bits (n,nbits).
+
+    n must be divisible by mesh size and the per-device shard a power of two.
+    Output is replicated on every device.
+    """
+
+    def local_msm(points, bits):
+        partial_sum = curve.g1_msm(points, bits)  # (3, L) local
+        gathered = jax.lax.all_gather(partial_sum, axis)  # (ndev, 3, L)
+        return curve.g1_reduce_sum(gathered)
+
+    fn = shard_map(
+        local_msm,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None)),
+        out_specs=P(),  # replicated
+    )
+    return jax.jit(fn)
+
+
+def sharded_g2_msm(mesh: Mesh, axis: str = "shares"):
+    def local_msm(points, bits):
+        partial_sum = curve.g2_msm(points, bits)  # (3, 2, L)
+        gathered = jax.lax.all_gather(partial_sum, axis)
+        return curve.g2_reduce_sum(gathered)
+
+    fn = shard_map(
+        local_msm,
+        mesh=mesh,
+        in_specs=(P(axis, None, None, None), P(axis, None)),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def pad_pow2(n: int, multiple: int) -> int:
+    """Smallest power of two >= n that is divisible by `multiple`."""
+    size = max(multiple, 1)
+    while size < n or size % multiple:
+        size *= 2
+    return size

