@@ -52,6 +52,12 @@ class EraRouter(Broadcaster):
         self._protocols: Dict[Any, Protocol] = {}
         self._extra_factories = extra_factories or {}
         self.terminated = False
+        # future-era messages buffered until the era advances (reference:
+        # postponed-message window, ConsensusManager.cs:132-155); bounded PER
+        # SENDER so one byzantine validator cannot starve honest traffic
+        self._postponed: list = []
+        self._postponed_per_sender: Dict[int, int] = {}
+        self._postponed_sender_cap = 256
 
     # -- Broadcaster interface ----------------------------------------------
     @property
@@ -94,12 +100,36 @@ class EraRouter(Broadcaster):
         except TypeError:
             logger.warning("unroutable payload from %d", sender)
             return
+        msg_era = getattr(pid, "era", None)
+        if msg_era is not None and msg_era != self.era:
+            if msg_era > self.era:
+                # a faster validator is already in a future era: buffer until
+                # we advance (reference postponed-message window)
+                cnt = self._postponed_per_sender.get(sender, 0)
+                if cnt < self._postponed_sender_cap:
+                    self._postponed_per_sender[sender] = cnt + 1
+                    self._postponed.append((sender, payload))
+            else:
+                logger.debug("stale era message %s from %d", pid, sender)
+            return
         if not self._validate_id(pid):
             logger.warning("invalid protocol id %s from %d", pid, sender)
             return
         proto = self._ensure_protocol(pid)
         if proto is not None:
             proto.receive(M.External(sender=sender, payload=payload))
+
+    def advance_era(self, new_era: int) -> None:
+        """Move FORWARD to a new era and replay buffered future-era messages
+        (reference: ConsensusManager.FinishEra -> Dispatch of postponed).
+        Eras never regress: a stale/duplicate call is a no-op."""
+        if new_era <= self.era:
+            return
+        self.era = new_era
+        pending, self._postponed = self._postponed, []
+        self._postponed_per_sender = {}
+        for sender, payload in pending:
+            self.dispatch_external(sender, payload)
 
     def result_of(self, pid) -> Any:
         proto = self._protocols.get(pid)

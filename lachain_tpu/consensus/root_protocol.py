@@ -1,0 +1,145 @@
+"""RootProtocol: the era driver.
+
+Behavioral parity with the reference
+(/root/reference/src/Lachain.Consensus/RootProtocol/RootProtocol.cs):
+  * on request: pull a tx proposal from the producer, feed HoneyBadger, and
+    request the era nonce coin (ProcessMessage 154-171; coin at 166-168)
+  * block nonce derived from the coin signature (316-322; here: the coin's
+    CoinId-era parity folded with the era index)
+  * on HB result: parse receipts, build + ECDSA-sign the header, broadcast
+    SignedHeaderMessage (TrySignHeader 222-262)
+  * collect N-F valid matching signed headers -> produce the block
+    (CheckSignatures 264-314)
+
+The producer dependency is a seam (core/block_producer.BlockProducer shape),
+so this protocol is testable against a fake producer.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..crypto import ecdsa
+from . import messages as M
+from .protocol import Broadcaster, Protocol
+
+NONCE_AGREEMENT = -1  # dedicated coin slot for the block nonce
+
+
+class RootProtocol(Protocol):
+    def __init__(
+        self,
+        pid: M.RootProtocolId,
+        broadcaster: Broadcaster,
+        producer,  # BlockProducer seam
+        ecdsa_priv: bytes,
+        ecdsa_pubs: List[bytes],
+    ):
+        super().__init__(pid, broadcaster)
+        self._producer = producer
+        self._priv = ecdsa_priv
+        self._pubs = ecdsa_pubs
+        self._hb_result: Optional[dict] = None
+        self._nonce: Optional[int] = None
+        self._header = None
+        self._txs = None
+        self._signatures: Dict[int, bytes] = {}
+        self._early_headers: List = []
+        self._produced = False
+
+    # -- era start -------------------------------------------------------------
+    def handle_input(self, value) -> None:
+        from ..core.block_producer import encode_tx_batch
+
+        proposal = self._producer.get_transactions_to_propose()
+        self.request(
+            M.HoneyBadgerId(era=self.id.era), encode_tx_batch(proposal)
+        )
+        self.request(
+            M.CoinId(era=self.id.era, agreement=NONCE_AGREEMENT, epoch=0), None
+        )
+
+    # -- children ---------------------------------------------------------------
+    def handle_child_result(self, child_id, value) -> None:
+        if isinstance(child_id, M.HoneyBadgerId):
+            if self._hb_result is None:
+                self._hb_result = value
+        elif isinstance(child_id, M.CoinId):
+            if self._nonce is None:
+                # fold coin into a u64 nonce (reference XOR-folds the combined
+                # signature, RootProtocol.cs:316-322)
+                self._nonce = (self.id.era << 1) | (1 if value else 0)
+        self._try_sign_header()
+
+    # -- header signing ----------------------------------------------------------
+    def _try_sign_header(self) -> None:
+        if self._header is not None or self._hb_result is None or self._nonce is None:
+            return
+        from ..core.block_producer import decode_tx_batch
+
+        seen: Set[bytes] = set()
+        txs = []
+        for slot in sorted(self._hb_result):
+            try:
+                batch = decode_tx_batch(self._hb_result[slot])
+            except (ValueError, AssertionError):
+                continue  # malformed proposal: skip the slot
+            for stx in batch:
+                h = stx.hash()
+                if h not in seen:
+                    seen.add(h)
+                    txs.append(stx)
+        self._txs = txs
+        self._header = self._producer.create_header(
+            self.id.era, txs, self._nonce
+        )
+        sig = ecdsa.sign_hash(self._priv, self._header.hash())
+        self.broadcaster.broadcast(
+            M.SignedHeaderMessage(
+                root=self.id,
+                header_bytes=self._header.encode(),
+                signature=sig,
+            )
+        )
+        self._signatures[self.me] = sig
+        # headers that arrived before ours was built
+        early, self._early_headers = self._early_headers, []
+        for sender, msg in early:
+            self._on_signed_header(sender, msg)
+        self._try_produce()
+
+    # -- externals ----------------------------------------------------------------
+    def handle_external(self, sender: int, payload) -> None:
+        if not isinstance(payload, M.SignedHeaderMessage):
+            raise TypeError(f"unexpected payload {type(payload)}")
+        if self._header is None:
+            if len(self._early_headers) < 4 * self.n:  # bounded stash
+                self._early_headers.append((sender, payload))
+            return
+        self._on_signed_header(sender, payload)
+
+    def _on_signed_header(self, sender: int, msg: M.SignedHeaderMessage) -> None:
+        if sender in self._signatures:
+            return
+        if msg.header_bytes != self._header.encode():
+            return  # disagreeing header (reference logs mismatch, 264-314)
+        if not ecdsa.verify_hash(
+            self._pubs[sender], self._header.hash(), msg.signature
+        ):
+            return
+        self._signatures[sender] = msg.signature
+        self._try_produce()
+
+    # -- production -----------------------------------------------------------------
+    def _try_produce(self) -> None:
+        if self._produced or self._header is None:
+            return
+        if len(self._signatures) < self.n - self.f:
+            return
+        from ..core.types import MultiSig
+
+        multisig = MultiSig(
+            signatures=tuple(sorted(self._signatures.items()))
+        )
+        block = self._producer.produce_block(self._header, self._txs, multisig)
+        self._produced = True
+        self.emit_result(block)

@@ -1,0 +1,185 @@
+"""Block emulate/execute/commit state machine.
+
+Parity with the reference's BlockManager
+(/root/reference/src/Lachain.Core/Blockchain/Operations/BlockManager.cs):
+  * Emulate — execute txs and compute the resulting state hash WITHOUT
+    committing (the reference does a rollback trick, BlockManager.cs:231-267;
+    functional snapshots make this free)
+  * Execute(commit, checkStateHash) — the canonical per-tx loop (304-560)
+  * block persistence + height index (BlockPersisted role)
+  * genesis building (Blockchain/Genesis/GenesisBuilder.cs:14-76)
+
+Determinism invariant (SURVEY.md §7 hard part #5): emulate and execute run
+the SAME pure function over the same base roots, so the state hash a
+validator signs in its header is exactly what executing the block produces.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..crypto.hashes import keccak256
+from ..storage.kv import EntryPrefix, KVStore, prefixed
+from ..storage.state import Snapshot, StateManager, StateRoots
+from ..utils.serialization import write_u64
+from .execution import TransactionExecuter, set_balance
+from .types import (
+    Block,
+    BlockHeader,
+    MultiSig,
+    SignedTransaction,
+    TransactionReceipt,
+    ZERO_HASH,
+    tx_merkle_root,
+)
+
+
+@dataclass
+class EmulationResult:
+    roots: StateRoots
+    state_hash: bytes
+    receipts: List
+
+
+class BlockManager:
+    def __init__(
+        self,
+        kv: KVStore,
+        state: StateManager,
+        executer: TransactionExecuter,
+    ):
+        self._kv = kv
+        self.state = state
+        self.executer = executer
+        self.on_block_persisted = []  # callbacks(block)
+
+    # -- ordering (deterministic across validators) ---------------------------
+    @staticmethod
+    def order_transactions(
+        txs: Sequence[SignedTransaction], chain_id: int
+    ) -> List[SignedTransaction]:
+        """Canonical execution order: (sender, nonce, hash) — every honest
+        node derives the identical order from the agreed tx set
+        (role of the reference's fee-ordering in BlockProducer.CreateHeader)."""
+        return sorted(
+            txs,
+            key=lambda stx: (
+                stx.sender(chain_id) or b"\xff" * 20,
+                stx.tx.nonce,
+                stx.hash(),
+            ),
+        )
+
+    # -- emulate --------------------------------------------------------------
+    def emulate(
+        self,
+        txs: Sequence[SignedTransaction],
+        block_index: int,
+        base: Optional[StateRoots] = None,
+    ) -> EmulationResult:
+        snap = self.state.new_snapshot(base)
+        receipts = []
+        for i, stx in enumerate(txs):
+            res = self.executer.execute(snap, stx, block_index, i)
+            receipts.append(res.receipt)
+        roots = snap.freeze()
+        return EmulationResult(
+            roots=roots, state_hash=roots.state_hash(), receipts=receipts
+        )
+
+    # -- execute + commit ------------------------------------------------------
+    def execute_block(
+        self,
+        header: BlockHeader,
+        txs: Sequence[SignedTransaction],
+        multisig: MultiSig,
+        check_state_hash: bool = True,
+    ) -> Block:
+        txs = self.order_transactions(txs, self.executer.chain_id)
+        em = self.emulate(txs, header.index)
+        if check_state_hash and em.state_hash != header.state_hash:
+            raise ValueError(
+                f"state hash mismatch at block {header.index}: "
+                f"{em.state_hash.hex()} != {header.state_hash.hex()}"
+            )
+        if tx_merkle_root([t.hash() for t in txs]) != header.merkle_root:
+            raise ValueError("tx merkle root mismatch")
+        block = Block(
+            header=header,
+            tx_hashes=tuple(t.hash() for t in txs),
+            multisig=multisig,
+        )
+        self._persist(block, txs, em)
+        return block
+
+    def _persist(self, block: Block, txs, em: EmulationResult) -> None:
+        h = block.hash()
+        puts = [
+            (prefixed(EntryPrefix.BLOCK_BY_HASH, h), block.encode()),
+            (
+                prefixed(
+                    EntryPrefix.BLOCK_HASH_BY_HEIGHT,
+                    write_u64(block.header.index),
+                ),
+                h,
+            ),
+        ]
+        for stx in txs:
+            puts.append(
+                (
+                    prefixed(EntryPrefix.TRANSACTION_BY_HASH, stx.hash()),
+                    stx.encode(),
+                )
+            )
+        self._kv.write_batch(puts)
+        self.state.commit(block.header.index, em.roots)
+        for cb in list(self.on_block_persisted):
+            cb(block)
+
+    # -- reads ----------------------------------------------------------------
+    def block_by_height(self, height: int) -> Optional[Block]:
+        h = self._kv.get(
+            prefixed(EntryPrefix.BLOCK_HASH_BY_HEIGHT, write_u64(height))
+        )
+        if h is None:
+            return None
+        return self.block_by_hash(h)
+
+    def block_by_hash(self, h: bytes) -> Optional[Block]:
+        enc = self._kv.get(prefixed(EntryPrefix.BLOCK_BY_HASH, h))
+        return Block.decode(enc) if enc else None
+
+    def transaction_by_hash(self, h: bytes) -> Optional[SignedTransaction]:
+        enc = self._kv.get(prefixed(EntryPrefix.TRANSACTION_BY_HASH, h))
+        return SignedTransaction.decode(enc) if enc else None
+
+    def receipt_by_hash(self, h: bytes) -> Optional[bytes]:
+        snap = self.state.new_snapshot()
+        return snap.get("transactions", h)
+
+    def current_height(self) -> int:
+        h = self.state.committed_height()
+        return h if h is not None else -1
+
+    # -- genesis ---------------------------------------------------------------
+    def build_genesis(
+        self, initial_balances: Dict[bytes, int], chain_id: int
+    ) -> Block:
+        """Reference: GenesisBuilder.cs:14-76 — block 0 with funded accounts."""
+        if self.block_by_height(0) is not None:
+            return self.block_by_height(0)
+        snap = self.state.new_snapshot(StateRoots())
+        for addr, bal in sorted(initial_balances.items()):
+            set_balance(snap, addr, bal)
+        roots = snap.freeze()
+        header = BlockHeader(
+            index=0,
+            prev_block_hash=ZERO_HASH,
+            merkle_root=ZERO_HASH,
+            state_hash=roots.state_hash(),
+            nonce=0,
+        )
+        block = Block(header=header, tx_hashes=(), multisig=MultiSig(()))
+        em = EmulationResult(roots=roots, state_hash=roots.state_hash(), receipts=[])
+        self._persist(block, [], em)
+        return block

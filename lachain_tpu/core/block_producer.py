@@ -1,0 +1,88 @@
+"""Block producer: tx proposal, header creation (emulate), block production.
+
+Parity with the reference's BlockProducer
+(/root/reference/src/Lachain.Core/Consensus/BlockProducer.cs):
+  * GetTransactionsToPropose — Peek(txsPerBlock / N) (73-91)
+  * CreateHeader — order txs, emulate, derive state hash (96-183)
+  * ProduceBlock — Execute(commit, checkStateHash) (187-220)
+
+This object is handed to RootProtocol (the IBlockProducer seam), keeping the
+consensus layer free of chain-state knowledge.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..utils.serialization import Reader, write_bytes_list
+from .block_manager import BlockManager
+from .tx_pool import TransactionPool
+from .types import (
+    Block,
+    BlockHeader,
+    MultiSig,
+    SignedTransaction,
+    tx_merkle_root,
+)
+
+DEFAULT_TXS_PER_BLOCK = 1000  # reference BlockProducer.cs:69
+
+
+def encode_tx_batch(txs: Sequence[SignedTransaction]) -> bytes:
+    """Wire form of a proposal (the RawShare payload fed into HoneyBadger)."""
+    return write_bytes_list([t.encode() for t in txs])
+
+
+def decode_tx_batch(data: bytes) -> List[SignedTransaction]:
+    r = Reader(data)
+    out = [SignedTransaction.decode(b) for b in r.bytes_list()]
+    r.assert_eof()
+    return out
+
+
+class BlockProducer:
+    def __init__(
+        self,
+        block_manager: BlockManager,
+        pool: TransactionPool,
+        n_validators: int,
+        txs_per_block: int = DEFAULT_TXS_PER_BLOCK,
+    ):
+        self.bm = block_manager
+        self.pool = pool
+        self.n = n_validators
+        self.txs_per_block = txs_per_block
+
+    # -- proposal ---------------------------------------------------------------
+    def get_transactions_to_propose(self) -> List[SignedTransaction]:
+        return self.pool.peek(max(self.txs_per_block // max(self.n, 1), 1))
+
+    # -- header -----------------------------------------------------------------
+    def create_header(
+        self, index: int, txs: Sequence[SignedTransaction], nonce: int
+    ) -> BlockHeader:
+        prev = self.bm.block_by_height(index - 1)
+        if prev is None:
+            raise ValueError(f"no parent block at height {index - 1}")
+        ordered = self.bm.order_transactions(txs, self.bm.executer.chain_id)
+        em = self.bm.emulate(ordered, index)
+        return BlockHeader(
+            index=index,
+            prev_block_hash=prev.hash(),
+            merkle_root=tx_merkle_root([t.hash() for t in ordered]),
+            state_hash=em.state_hash,
+            nonce=nonce,
+        )
+
+    # -- production -------------------------------------------------------------
+    def produce_block(
+        self,
+        header: BlockHeader,
+        txs: Sequence[SignedTransaction],
+        multisig: MultiSig,
+    ) -> Block:
+        block = self.bm.execute_block(
+            header, txs, multisig, check_state_hash=True
+        )
+        self.pool.remove_included(block.tx_hashes)
+        self.pool.sanitize()
+        return block

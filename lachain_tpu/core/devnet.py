@@ -1,0 +1,167 @@
+"""Single-process multi-validator devnet — the end-to-end slice.
+
+Parity with the reference's 4-node local net (docker-compose.4nodes.yml +
+TrustedKeygen, SURVEY.md §4.5) collapsed into one process for tests and the
+bench: N validators, each with its own KV store / state / pool / producer,
+wired through the deterministic simulator. The era loop plays the role of
+ConsensusManager.Run (/root/reference/src/Lachain.Core/Consensus/
+ConsensusManager.cs:191-360): start RootProtocol for era E, wait for every
+node's block, verify they all committed the same block, advance.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..consensus import messages as M
+from ..consensus.keys import trusted_key_gen
+from ..consensus.root_protocol import RootProtocol
+from ..consensus.simulator import DeliveryMode, SimulatedNetwork
+from ..crypto import ecdsa
+from ..storage.kv import MemoryKV
+from ..storage.state import StateManager
+from .block_manager import BlockManager
+from .block_producer import BlockProducer
+from .execution import TransactionExecuter, get_balance, get_nonce
+from .tx_pool import TransactionPool
+from .types import Block, SignedTransaction, Transaction, sign_transaction
+
+DEFAULT_CHAIN_ID = 225  # our own chain id
+
+
+@dataclass
+class DevnetNode:
+    index: int
+    kv: MemoryKV
+    state: StateManager
+    block_manager: BlockManager
+    pool: TransactionPool
+    producer: BlockProducer
+
+
+class Devnet:
+    """N-validator in-process chain with HoneyBadger consensus."""
+
+    def __init__(
+        self,
+        n: int = 4,
+        f: int = 1,
+        chain_id: int = DEFAULT_CHAIN_ID,
+        seed: int = 0,
+        txs_per_block: int = 1000,
+        initial_balances: Optional[Dict[bytes, int]] = None,
+        mode: DeliveryMode = DeliveryMode.TAKE_FIRST,
+    ):
+        self.n, self.f = n, f
+        self.chain_id = chain_id
+        rng = random.Random(seed)
+
+        class _Rng:
+            def randbelow(self, k):
+                return rng.randrange(k)
+
+        self.public_keys, self.private_keys = trusted_key_gen(n, f, rng=_Rng())
+        self.initial_balances = dict(initial_balances or {})
+
+        self.nodes: List[DevnetNode] = []
+        for i in range(n):
+            kv = MemoryKV()
+            state = StateManager(kv)
+            executer = TransactionExecuter(chain_id)
+            bm = BlockManager(kv, state, executer)
+            bm.build_genesis(self.initial_balances, chain_id)
+            pool = TransactionPool(
+                kv,
+                chain_id,
+                account_nonce=self._nonce_reader(state),
+            )
+            producer = BlockProducer(bm, pool, n, txs_per_block)
+            self.nodes.append(
+                DevnetNode(
+                    index=i,
+                    kv=kv,
+                    state=state,
+                    block_manager=bm,
+                    pool=pool,
+                    producer=producer,
+                )
+            )
+
+        def root_factory_for(node: DevnetNode):
+            def factory(pid, router):
+                return RootProtocol(
+                    pid,
+                    router,
+                    producer=node.producer,
+                    ecdsa_priv=self.private_keys[node.index].ecdsa_priv,
+                    ecdsa_pubs=self.public_keys.ecdsa_pub_keys,
+                )
+
+            return factory
+
+        # one shared simulated network; per-node RootProtocol factories
+        self.net = SimulatedNetwork(
+            self.public_keys,
+            self.private_keys,
+            era=1,
+            seed=seed,
+            mode=mode,
+        )
+        for i, router in enumerate(self.net.routers):
+            router._extra_factories[M.RootProtocolId] = root_factory_for(
+                self.nodes[i]
+            )
+
+    @staticmethod
+    def _nonce_reader(state: StateManager):
+        def read(addr: bytes) -> int:
+            return get_nonce(state.new_snapshot(), addr)
+
+        return read
+
+    # -- tx ingress -------------------------------------------------------------
+    def submit_tx(self, stx: SignedTransaction, to_node: int = 0) -> bool:
+        """Reference path: eth_sendRawTransaction -> TransactionPool.Add; the
+        devnet gossips the tx to every node's pool (BroadcastLocalTransaction
+        role)."""
+        ok = self.nodes[to_node].pool.add(stx)
+        if ok:
+            for node in self.nodes:
+                if node.index != to_node:
+                    node.pool.add(stx)
+        return ok
+
+    # -- era loop ----------------------------------------------------------------
+    def run_era(self, era: int, max_messages: int = 2_000_000) -> List[Block]:
+        """Run one consensus era to completion on every node."""
+        for router in self.net.routers:
+            router.advance_era(era)
+        pid = M.RootProtocolId(era=era)
+        for i in range(self.n):
+            self.net.post_request(i, pid, None)
+        ok = self.net.run(
+            lambda: all(
+                r.result_of(pid) is not None for r in self.net.routers
+            ),
+            max_messages=max_messages,
+        )
+        if not ok:
+            raise RuntimeError(f"era {era} did not complete")
+        blocks = [r.result_of(pid) for r in self.net.routers]
+        h0 = blocks[0].hash()
+        assert all(b.hash() == h0 for b in blocks), "devnet fork!"
+        return blocks
+
+    def run_eras(self, first: int, count: int) -> List[Block]:
+        out = []
+        for era in range(first, first + count):
+            out.append(self.run_era(era)[0])
+        return out
+
+    # -- helpers ------------------------------------------------------------------
+    def balance(self, addr: bytes, node: int = 0) -> int:
+        return get_balance(self.nodes[node].state.new_snapshot(), addr)
+
+    def height(self, node: int = 0) -> int:
+        return self.nodes[node].block_manager.current_height()

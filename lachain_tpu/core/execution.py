@@ -1,0 +1,112 @@
+"""Transaction execution over state snapshots.
+
+Parity with the reference's execution path
+(/root/reference/src/Lachain.Core/Blockchain/Operations/TransactionManager.cs:88-140
+and TransactionExecuter.cs:1-153): per-tx signature/nonce/balance checks,
+native transfers, system-contract dispatch, receipts into the transactions
+subtree.
+
+The reference wraps every tx in snapshot/approve/rollback
+(BlockManager._Execute, BlockManager.cs:371-560); here a failed tx simply
+discards its buffered writes — the functional snapshot makes the rollback
+trick free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto.hashes import keccak256
+from ..storage.state import Snapshot
+from ..utils.serialization import Reader, write_u64, write_u256
+from .types import (
+    ADDRESS_BYTES,
+    SignedTransaction,
+    TransactionReceipt,
+    ZERO_ADDRESS,
+)
+
+GAS_PER_TX = 21000  # base transfer cost (reference GasMetering.cs)
+
+_BALANCE = b"b:"
+_NONCE = b"n:"
+
+
+def get_balance(snap: Snapshot, addr: bytes) -> int:
+    raw = snap.get("balances", _BALANCE + addr)
+    return int.from_bytes(raw, "big") if raw else 0
+
+
+def set_balance(snap: Snapshot, addr: bytes, value: int) -> None:
+    snap.put("balances", _BALANCE + addr, write_u256(value))
+
+
+def get_nonce(snap: Snapshot, addr: bytes) -> int:
+    raw = snap.get("balances", _NONCE + addr)
+    return int.from_bytes(raw, "big") if raw else 0
+
+
+def set_nonce(snap: Snapshot, addr: bytes, value: int) -> None:
+    snap.put("balances", _NONCE + addr, write_u64(value))
+
+
+@dataclass
+class ExecutionResult:
+    receipt: TransactionReceipt
+    ok: bool
+
+
+class TransactionExecuter:
+    """Executes one signed transaction against a snapshot."""
+
+    def __init__(self, chain_id: int, system_contracts=None):
+        self.chain_id = chain_id
+        # address -> callable(snap, sender, tx, block_index) -> (status, ret)
+        self.system_contracts = system_contracts or {}
+
+    def execute(
+        self,
+        snap: Snapshot,
+        stx: SignedTransaction,
+        block_index: int,
+        index_in_block: int,
+    ) -> ExecutionResult:
+        tx_hash = stx.hash()
+
+        def receipt(status: int, sender: bytes, ret: bytes = b"") -> ExecutionResult:
+            r = TransactionReceipt(
+                tx_hash=tx_hash,
+                block_index=block_index,
+                index_in_block=index_in_block,
+                gas_used=GAS_PER_TX,
+                status=status,
+                sender=sender,
+                return_data=ret,
+            )
+            snap.put("transactions", tx_hash, r.encode())
+            return ExecutionResult(receipt=r, ok=status == 1)
+
+        sender = stx.sender(self.chain_id)
+        if sender is None:
+            return receipt(0, ZERO_ADDRESS)
+        tx = stx.tx
+        if get_nonce(snap, sender) != tx.nonce:
+            return receipt(0, sender)
+        fee = GAS_PER_TX * tx.gas_price
+        bal = get_balance(snap, sender)
+        if bal < tx.value + fee:
+            return receipt(0, sender)
+        # effects
+        set_nonce(snap, sender, tx.nonce + 1)
+        set_balance(snap, sender, bal - tx.value - fee)
+        if tx.to in self.system_contracts:
+            handler = self.system_contracts[tx.to]
+            try:
+                status, ret = handler(snap, sender, tx, block_index)
+            except Exception:
+                status, ret = 0, b""
+            # value moved to the contract address either way
+            set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
+            return receipt(status, sender, ret)
+        set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
+        return receipt(1, sender)

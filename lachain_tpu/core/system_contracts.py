@@ -1,0 +1,412 @@
+"""System contracts: in-process "precompiles" dispatched by address.
+
+Parity with the reference's system-contract layer
+(/root/reference/src/Lachain.Core/Blockchain/SystemContracts/):
+  * ContractRegisterer — address 0x0..0x4 dispatch via a selector registry
+    (ContractManager/ContractRegisterer.cs:28-62)
+  * DeployContract      (DeployContract.cs:1-213)   -> address 0x0
+  * NativeTokenContract (NativeTokenContract.cs, LRC-20) -> 0x1
+  * GovernanceContract  (GovernanceContract.cs: keygen tx lifecycle +
+    ChangeValidators + FinishCycle)                 -> 0x2
+  * StakingContract     (StakingContract.cs: stake lifecycle + VRF lottery
+    SubmitVrf/FinishVrfLottery + cycle constants)   -> 0x3
+
+ABI: 4-byte keccak selector + fixed-width args (role of ContractEncoder /
+ContractDecoder, VM/ContractEncoder.cs:1-169). Contract storage lives in the
+'storage' subtree under (contract_address || key).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import vrf
+from ..crypto.hashes import keccak256
+from ..storage.state import Snapshot
+from ..utils.serialization import Reader, write_bytes, write_u32, write_u64, write_u256
+from . import execution
+from .types import ADDRESS_BYTES, Transaction, ZERO_ADDRESS
+
+DEPLOY_ADDRESS = b"\x00" * 19 + b"\x00"
+NATIVE_TOKEN_ADDRESS = b"\x00" * 19 + b"\x01"
+GOVERNANCE_ADDRESS = b"\x00" * 19 + b"\x02"
+STAKING_ADDRESS = b"\x00" * 19 + b"\x03"
+
+# cycle parameters (reference StakingContract.cs:63-71; config-initialized)
+CYCLE_DURATION = 1000  # blocks per validator cycle
+VRF_SUBMISSION_PHASE = 500  # blocks of the cycle accepting VRF submissions
+ATTENDANCE_DETECTION_DURATION = 100
+
+
+def selector(signature: str) -> bytes:
+    return keccak256(signature.encode())[:4]
+
+
+# method selectors
+SEL_DEPLOY = selector("deploy(bytes)")
+SEL_TRANSFER = selector("transfer(address,uint256)")
+SEL_BALANCE_OF = selector("balanceOf(address)")
+SEL_TOTAL_SUPPLY = selector("totalSupply()")
+SEL_BECOME_STAKER = selector("becomeStaker(bytes,uint256)")
+SEL_REQUEST_WITHDRAW = selector("requestStakeWithdrawal(bytes)")
+SEL_WITHDRAW = selector("withdrawStake(bytes)")
+SEL_SUBMIT_VRF = selector("submitVrf(bytes,bytes)")
+SEL_FINISH_LOTTERY = selector("finishVrfLottery()")
+SEL_GET_STAKE = selector("getStake(address)")
+SEL_KEYGEN_COMMIT = selector("keygenCommit(bytes)")
+SEL_KEYGEN_SEND_VALUE = selector("keygenSendValue(uint256,bytes)")
+SEL_KEYGEN_CONFIRM = selector("keygenConfirm(bytes)")
+SEL_CHANGE_VALIDATORS = selector("changeValidators(bytes)")
+SEL_FINISH_CYCLE = selector("finishCycle()")
+
+
+def _skey(contract: bytes, key: bytes) -> bytes:
+    return contract + key
+
+
+class SystemContractContext:
+    """Shared context handed to every contract call."""
+
+    def __init__(self, snap: Snapshot, sender: bytes, tx: Transaction, block: int):
+        self.snap = snap
+        self.sender = sender
+        self.tx = tx
+        self.block = block
+        self.events: List[Tuple[bytes, bytes]] = []
+
+    # contract-storage accessors ('storage' subtree)
+    def sget(self, contract: bytes, key: bytes) -> Optional[bytes]:
+        return self.snap.get("storage", _skey(contract, key))
+
+    def sput(self, contract: bytes, key: bytes, value: bytes) -> None:
+        self.snap.put("storage", _skey(contract, key), value)
+
+    def sdel(self, contract: bytes, key: bytes) -> None:
+        self.snap.delete("storage", _skey(contract, key))
+
+    def emit(self, contract: bytes, data: bytes) -> None:
+        self.events.append((contract, data))
+        self.snap.put(
+            "events",
+            keccak256(contract + data + write_u64(self.block)),
+            contract + data,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Deploy (reference DeployContract.cs) — stores contract bytecode; execution
+# of deployed code arrives with the VM layer.
+# ---------------------------------------------------------------------------
+
+
+def deploy_contract(ctx: SystemContractContext, args: Reader) -> Tuple[int, bytes]:
+    code = args.bytes_()
+    if not code or len(code) > 512 * 1024:
+        return 0, b""
+    addr = keccak256(ctx.sender + write_u64(ctx.tx.nonce))[12:]
+    if ctx.snap.get("contracts", addr) is not None:
+        return 0, b""
+    ctx.snap.put("contracts", addr, code)
+    ctx.emit(DEPLOY_ADDRESS, b"deployed" + addr)
+    return 1, addr
+
+
+# ---------------------------------------------------------------------------
+# Native token (reference NativeTokenContract.cs, LRC-20 surface)
+# ---------------------------------------------------------------------------
+
+
+def native_token(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, bytes]:
+    if sel == SEL_TOTAL_SUPPLY:
+        # supply = sum of genesis allocations + staking rewards; tracked key
+        raw = ctx.sget(NATIVE_TOKEN_ADDRESS, b"supply")
+        return 1, raw or write_u256(0)
+    if sel == SEL_BALANCE_OF:
+        addr = args.raw(ADDRESS_BYTES)
+        return 1, write_u256(execution.get_balance(ctx.snap, addr))
+    if sel == SEL_TRANSFER:
+        to = args.raw(ADDRESS_BYTES)
+        amount = args.u256()
+        bal = execution.get_balance(ctx.snap, ctx.sender)
+        if bal < amount:
+            return 0, b""
+        execution.set_balance(ctx.snap, ctx.sender, bal - amount)
+        execution.set_balance(
+            ctx.snap, to, execution.get_balance(ctx.snap, to) + amount
+        )
+        ctx.emit(NATIVE_TOKEN_ADDRESS, b"transfer" + ctx.sender + to + write_u256(amount))
+        return 1, write_u256(1)
+    return 0, b""
+
+
+# ---------------------------------------------------------------------------
+# Staking (reference StakingContract.cs): stake lifecycle + VRF lottery
+# ---------------------------------------------------------------------------
+
+
+def _stakers_key() -> bytes:
+    return b"stakers"
+
+
+def _get_staker_list(ctx) -> List[bytes]:
+    raw = ctx.sget(STAKING_ADDRESS, _stakers_key())
+    if not raw:
+        return []
+    r = Reader(raw)
+    return r.bytes_list()
+
+
+def _put_staker_list(ctx, stakers: List[bytes]) -> None:
+    from ..utils.serialization import write_bytes_list
+
+    ctx.sput(STAKING_ADDRESS, _stakers_key(), write_bytes_list(stakers))
+
+
+def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, bytes]:
+    if sel == SEL_BECOME_STAKER:
+        pubkey = args.bytes_()  # validator ECDSA pubkey
+        amount = args.u256()
+        if len(pubkey) != 33 or amount <= 0:
+            return 0, b""
+        bal = execution.get_balance(ctx.snap, ctx.sender)
+        if bal < amount:
+            return 0, b""
+        execution.set_balance(ctx.snap, ctx.sender, bal - amount)
+        prev = ctx.sget(STAKING_ADDRESS, b"stake:" + ctx.sender)
+        prev_amount = int.from_bytes(prev, "big") if prev else 0
+        ctx.sput(
+            STAKING_ADDRESS, b"stake:" + ctx.sender, write_u256(prev_amount + amount)
+        )
+        ctx.sput(STAKING_ADDRESS, b"pub:" + ctx.sender, pubkey)
+        stakers = _get_staker_list(ctx)
+        if ctx.sender not in stakers:
+            stakers.append(ctx.sender)
+            _put_staker_list(ctx, stakers)
+        total = ctx.sget(STAKING_ADDRESS, b"total")
+        total_amount = int.from_bytes(total, "big") if total else 0
+        ctx.sput(STAKING_ADDRESS, b"total", write_u256(total_amount + amount))
+        ctx.emit(STAKING_ADDRESS, b"staked" + ctx.sender + write_u256(amount))
+        return 1, b""
+
+    if sel == SEL_GET_STAKE:
+        addr = args.raw(ADDRESS_BYTES)
+        raw = ctx.sget(STAKING_ADDRESS, b"stake:" + addr)
+        return 1, raw or write_u256(0)
+
+    if sel == SEL_REQUEST_WITHDRAW:
+        # withdrawal queued; paid out at the cycle boundary (reference's
+        # two-phase withdrawal, StakingContract withdrawal flow)
+        raw = ctx.sget(STAKING_ADDRESS, b"stake:" + ctx.sender)
+        if not raw or int.from_bytes(raw, "big") == 0:
+            return 0, b""
+        ctx.sput(STAKING_ADDRESS, b"withdraw:" + ctx.sender, raw)
+        return 1, b""
+
+    if sel == SEL_WITHDRAW:
+        raw = ctx.sget(STAKING_ADDRESS, b"withdraw:" + ctx.sender)
+        if not raw:
+            return 0, b""
+        amount = int.from_bytes(raw, "big")
+        stake_raw = ctx.sget(STAKING_ADDRESS, b"stake:" + ctx.sender)
+        stake_amount = int.from_bytes(stake_raw, "big") if stake_raw else 0
+        pay = min(amount, stake_amount)
+        if pay == 0:
+            return 0, b""
+        ctx.sput(STAKING_ADDRESS, b"stake:" + ctx.sender, write_u256(stake_amount - pay))
+        ctx.sdel(STAKING_ADDRESS, b"withdraw:" + ctx.sender)
+        total = int.from_bytes(ctx.sget(STAKING_ADDRESS, b"total") or b"", "big") if ctx.sget(STAKING_ADDRESS, b"total") else 0
+        ctx.sput(STAKING_ADDRESS, b"total", write_u256(max(total - pay, 0)))
+        execution.set_balance(
+            ctx.snap,
+            ctx.sender,
+            execution.get_balance(ctx.snap, ctx.sender) + pay,
+        )
+        ctx.emit(STAKING_ADDRESS, b"withdrawn" + ctx.sender + write_u256(pay))
+        return 1, b""
+
+    if sel == SEL_SUBMIT_VRF:
+        # (reference SubmitVrf, StakingContract.cs:458-537): within the VRF
+        # phase, a staker proves a winning lottery roll for the next cycle
+        if ctx.block % CYCLE_DURATION >= VRF_SUBMISSION_PHASE:
+            return 0, b""
+        pubkey = args.bytes_()
+        proof = args.bytes_()
+        stored_pub = ctx.sget(STAKING_ADDRESS, b"pub:" + ctx.sender)
+        if stored_pub != pubkey:
+            return 0, b""
+        stake_raw = ctx.sget(STAKING_ADDRESS, b"stake:" + ctx.sender)
+        stake_amount = int.from_bytes(stake_raw, "big") if stake_raw else 0
+        if stake_amount == 0:
+            return 0, b""
+        total_raw = ctx.sget(STAKING_ADDRESS, b"total")
+        total = int.from_bytes(total_raw, "big") if total_raw else 0
+        cycle = ctx.block // CYCLE_DURATION
+        seed = ctx.sget(STAKING_ADDRESS, b"seed") or b"genesis-seed"
+        alpha = seed + write_u64(cycle)
+        if not vrf.verify(pubkey, alpha, proof):
+            return 0, b""
+        beta = vrf.proof_to_hash(proof)
+        expected = int.from_bytes(
+            ctx.sget(STAKING_ADDRESS, b"validators_count") or write_u32(7), "big"
+        )
+        if not vrf.is_winner(beta, stake_amount, total, expected):
+            return 0, b""
+        # record the winner for the cycle
+        key = b"winner:" + write_u64(cycle) + ctx.sender
+        if ctx.sget(STAKING_ADDRESS, key) is not None:
+            return 0, b""  # duplicate submission
+        ctx.sput(STAKING_ADDRESS, key, pubkey + beta)
+        winners = _get_winner_list(ctx, cycle)
+        winners.append(ctx.sender)
+        _put_winner_list(ctx, cycle, winners)
+        ctx.emit(STAKING_ADDRESS, b"vrf" + ctx.sender)
+        return 1, b""
+
+    if sel == SEL_FINISH_LOTTERY:
+        # (reference FinishVrfLottery, StakingContract.cs:738-747): close the
+        # phase, pick the next validator set from the winners
+        cycle = ctx.block // CYCLE_DURATION
+        winners = _get_winner_list(ctx, cycle)
+        pubs = []
+        for w in winners:
+            rec = ctx.sget(STAKING_ADDRESS, b"winner:" + write_u64(cycle) + w)
+            if rec:
+                pubs.append(rec[:33])
+        if pubs:
+            from ..utils.serialization import write_bytes_list
+
+            ctx.sput(
+                STAKING_ADDRESS,
+                b"next_validators",
+                write_bytes_list(pubs),
+            )
+            # roll the seed forward
+            ctx.sput(
+                STAKING_ADDRESS,
+                b"seed",
+                keccak256((ctx.sget(STAKING_ADDRESS, b"seed") or b"") + write_u64(cycle)),
+            )
+            ctx.emit(STAKING_ADDRESS, b"lottery_done" + write_u64(cycle))
+            return 1, b""
+        return 0, b""
+
+    return 0, b""
+
+
+def _get_winner_list(ctx, cycle: int) -> List[bytes]:
+    raw = ctx.sget(STAKING_ADDRESS, b"winners:" + write_u64(cycle))
+    if not raw:
+        return []
+    return Reader(raw).bytes_list()
+
+
+def _put_winner_list(ctx, cycle: int, winners: List[bytes]) -> None:
+    from ..utils.serialization import write_bytes_list
+
+    ctx.sput(
+        STAKING_ADDRESS, b"winners:" + write_u64(cycle), write_bytes_list(winners)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Governance (reference GovernanceContract.cs): keygen tx lifecycle + the
+# validator-set change. The DKG math itself lives in consensus/keygen.py;
+# these methods are the on-chain message board the keygen rides on.
+# ---------------------------------------------------------------------------
+
+
+def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, bytes]:
+    if sel == SEL_KEYGEN_COMMIT:
+        blob = args.bytes_()
+        key = b"commit:" + write_u64(ctx.block // CYCLE_DURATION) + ctx.sender
+        ctx.sput(GOVERNANCE_ADDRESS, key, blob)
+        ctx.emit(GOVERNANCE_ADDRESS, b"keygen_commit" + ctx.sender + blob)
+        return 1, b""
+    if sel == SEL_KEYGEN_SEND_VALUE:
+        round_no = args.u256()
+        blob = args.bytes_()
+        key = (
+            b"value:"
+            + write_u64(ctx.block // CYCLE_DURATION)
+            + write_u64(round_no & 0xFFFFFFFFFFFFFFFF)
+            + ctx.sender
+        )
+        ctx.sput(GOVERNANCE_ADDRESS, key, blob)
+        ctx.emit(GOVERNANCE_ADDRESS, b"keygen_value" + ctx.sender + blob)
+        return 1, b""
+    if sel == SEL_KEYGEN_CONFIRM:
+        blob = args.bytes_()  # serialized new public key set
+        cycle = ctx.block // CYCLE_DURATION
+        h = keccak256(blob)
+        cnt_key = b"confirms:" + write_u64(cycle) + h
+        raw = ctx.sget(GOVERNANCE_ADDRESS, cnt_key)
+        voters = Reader(raw).bytes_list() if raw else []
+        if ctx.sender in voters:
+            return 0, b""
+        voters.append(ctx.sender)
+        from ..utils.serialization import write_bytes_list
+
+        ctx.sput(GOVERNANCE_ADDRESS, cnt_key, write_bytes_list(voters))
+        ctx.sput(GOVERNANCE_ADDRESS, b"candidate:" + h, blob)
+        ctx.emit(GOVERNANCE_ADDRESS, b"keygen_confirm" + ctx.sender)
+        return 1, write_u32(len(voters))
+    if sel == SEL_CHANGE_VALIDATORS:
+        blob = args.bytes_()
+        ctx.sput(GOVERNANCE_ADDRESS, b"pending_validators", blob)
+        ctx.emit(GOVERNANCE_ADDRESS, b"change_validators")
+        return 1, b""
+    if sel == SEL_FINISH_CYCLE:
+        pending = ctx.sget(GOVERNANCE_ADDRESS, b"pending_validators")
+        if pending:
+            ctx.snap.put("validators", b"current", pending)
+            ctx.sdel(GOVERNANCE_ADDRESS, b"pending_validators")
+            ctx.emit(GOVERNANCE_ADDRESS, b"cycle_finished")
+            return 1, b""
+        return 0, b""
+    return 0, b""
+
+
+# ---------------------------------------------------------------------------
+# Registry / dispatcher (reference ContractRegisterer.cs)
+# ---------------------------------------------------------------------------
+
+
+def dispatch(snap: Snapshot, sender: bytes, tx: Transaction, block: int) -> Tuple[int, bytes]:
+    ctx = SystemContractContext(snap, sender, tx, block)
+    data = tx.invocation
+    if len(data) < 4:
+        return 0, b""
+    sel, rest = data[:4], Reader(data[4:])
+    try:
+        if tx.to == DEPLOY_ADDRESS and sel == SEL_DEPLOY:
+            return deploy_contract(ctx, rest)
+        if tx.to == NATIVE_TOKEN_ADDRESS:
+            return native_token(ctx, sel, rest)
+        if tx.to == STAKING_ADDRESS:
+            return staking(ctx, sel, rest)
+        if tx.to == GOVERNANCE_ADDRESS:
+            return governance(ctx, sel, rest)
+    except (ValueError, AssertionError):
+        return 0, b""
+    return 0, b""
+
+
+SYSTEM_CONTRACTS: Dict[bytes, Callable] = {
+    addr: dispatch
+    for addr in (
+        DEPLOY_ADDRESS,
+        NATIVE_TOKEN_ADDRESS,
+        GOVERNANCE_ADDRESS,
+        STAKING_ADDRESS,
+    )
+}
+
+
+def make_executer(chain_id: int) -> execution.TransactionExecuter:
+    """TransactionExecuter wired with the system-contract registry."""
+    return execution.TransactionExecuter(
+        chain_id,
+        system_contracts={
+            addr: lambda snap, sender, tx, block: dispatch(snap, sender, tx, block)
+            for addr in SYSTEM_CONTRACTS
+        },
+    )

@@ -1,0 +1,140 @@
+"""Transaction pool (mempool).
+
+Parity with the reference's TransactionPool
+(/root/reference/src/Lachain.Core/Blockchain/Pool/TransactionPool.cs):
+  * Add: signature verify + nonce bookkeeping + persistence (130-148)
+  * Peek: fee-ordered proposal sampling with per-sender nonce continuity
+    (401+; NonceCalculator.cs:21)
+  * Restore from the persistent repo on startup (98+)
+  * eviction of included/stale transactions
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..storage.kv import EntryPrefix, KVStore, prefixed
+from .types import SignedTransaction
+
+
+class TransactionPool:
+    def __init__(
+        self,
+        kv: KVStore,
+        chain_id: int,
+        account_nonce: Callable[[bytes], int],
+        min_gas_price: int = 1,
+    ):
+        self._kv = kv
+        self.chain_id = chain_id
+        self._account_nonce = account_nonce
+        self.min_gas_price = min_gas_price
+        self._lock = threading.RLock()
+        self._txs: Dict[bytes, SignedTransaction] = {}
+        self._senders: Dict[bytes, bytes] = {}  # tx hash -> sender
+        # (sender, nonce) -> tx hash (reference TransactionHashTrackerByNonce)
+        self._by_nonce: Dict[Tuple[bytes, int], bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+    # -- ingress --------------------------------------------------------------
+    def add(self, stx: SignedTransaction) -> bool:
+        """Verify + admit. Returns False (and drops) on any rule violation."""
+        h = stx.hash()
+        with self._lock:
+            if h in self._txs:
+                return False
+            if stx.tx.gas_price < self.min_gas_price:
+                return False
+            sender = stx.sender(self.chain_id)
+            if sender is None:
+                return False
+            current = self._account_nonce(sender)
+            if stx.tx.nonce < current:
+                return False  # already used
+            key = (sender, stx.tx.nonce)
+            if key in self._by_nonce:
+                # replacement only for strictly higher fee
+                old = self._txs.get(self._by_nonce[key])
+                if old is not None and stx.tx.gas_price <= old.tx.gas_price:
+                    return False
+                self._evict(self._by_nonce[key])
+            self._txs[h] = stx
+            self._senders[h] = sender
+            self._by_nonce[key] = h
+            self._kv.put(prefixed(EntryPrefix.POOL_TX, h), stx.encode())
+            return True
+
+    # -- proposal --------------------------------------------------------------
+    def peek(self, max_txs: int) -> List[SignedTransaction]:
+        """Fee-ordered proposal with per-sender nonce continuity."""
+        with self._lock:
+            per_sender: Dict[bytes, List[SignedTransaction]] = {}
+            for h, stx in self._txs.items():
+                per_sender.setdefault(self._senders[h], []).append(stx)
+            candidates: List[Tuple[int, bytes, SignedTransaction]] = []
+            for sender, txs in per_sender.items():
+                txs.sort(key=lambda t: t.tx.nonce)
+                nonce = self._account_nonce(sender)
+                for t in txs:
+                    if t.tx.nonce != nonce:
+                        break  # gap: later nonces are unexecutable
+                    candidates.append((t.tx.gas_price, t.hash(), t))
+                    nonce += 1
+            candidates.sort(key=lambda c: (-c[0], c[1]))
+            picked: List[SignedTransaction] = []
+            taken_count: Dict[bytes, int] = {}
+            for _, _, t in candidates:
+                if len(picked) >= max_txs:
+                    break
+                sender = self._senders[t.hash()]
+                # keep nonce continuity within the proposal
+                expect = self._account_nonce(sender) + taken_count.get(sender, 0)
+                if t.tx.nonce != expect:
+                    continue
+                picked.append(t)
+                taken_count[sender] = taken_count.get(sender, 0) + 1
+            return picked
+
+    # -- lifecycle --------------------------------------------------------------
+    def remove_included(self, tx_hashes) -> None:
+        with self._lock:
+            for h in tx_hashes:
+                self._evict(h)
+
+    def sanitize(self) -> int:
+        """Drop txs whose nonce is now stale (reference sanitize-on-persist,
+        TransactionPool.cs:79-90). Returns number evicted."""
+        with self._lock:
+            stale = [
+                h
+                for h, stx in self._txs.items()
+                if stx.tx.nonce < self._account_nonce(self._senders[h])
+            ]
+            for h in stale:
+                self._evict(h)
+            return len(stale)
+
+    def restore(self) -> int:
+        """Reload persisted pool txs (reference Restore, TransactionPool.cs:98)."""
+        count = 0
+        for key, enc in self._kv.scan_prefix(prefixed(EntryPrefix.POOL_TX)):
+            try:
+                stx = SignedTransaction.decode(enc)
+            except (ValueError, AssertionError):
+                self._kv.delete(key)
+                continue
+            if self.add(stx):
+                count += 1
+        return count
+
+    def _evict(self, h: bytes) -> None:
+        stx = self._txs.pop(h, None)
+        sender = self._senders.pop(h, None)
+        if stx is not None and sender is not None:
+            self._by_nonce.pop((sender, stx.tx.nonce), None)
+        self._kv.delete(prefixed(EntryPrefix.POOL_TX, h))
+
+    def get(self, h: bytes) -> Optional[SignedTransaction]:
+        return self._txs.get(h)

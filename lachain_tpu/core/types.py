@@ -1,0 +1,212 @@
+"""Chain data types: transactions, receipts, block headers, blocks.
+
+Parity with the reference's proto layer
+(/root/reference/src/Lachain.Proto: transaction.proto, block.proto) and the
+tx-hashing rules (src/Lachain.Crypto/TransactionUtils.cs:1-107). Our wire
+format is the framework's fixed-width codec; hashes are keccak256 over the
+canonical encoding (chain-id mixed into the signing hash, EIP-155-style).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence, Tuple
+
+from ..crypto import ecdsa
+from ..crypto.hashes import keccak256, merkle_root
+from ..utils.serialization import (
+    Reader,
+    write_bytes,
+    write_bytes_list,
+    write_u32,
+    write_u64,
+    write_u256,
+)
+
+ADDRESS_BYTES = 20
+ZERO_ADDRESS = b"\x00" * ADDRESS_BYTES
+ZERO_HASH = b"\x00" * 32
+
+
+@dataclass(frozen=True)
+class Transaction:
+    """A transfer / contract call (reference: transaction.proto Transaction)."""
+
+    to: bytes  # 20 bytes; ZERO_ADDRESS + invocation => deploy
+    value: int  # wei-style u256
+    nonce: int
+    gas_price: int
+    gas_limit: int
+    invocation: bytes = b""  # contract input
+
+    def encode(self) -> bytes:
+        return (
+            self.to
+            + write_u256(self.value)
+            + write_u64(self.nonce)
+            + write_u256(self.gas_price)
+            + write_u64(self.gas_limit)
+            + write_bytes(self.invocation)
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Transaction":
+        r = Reader(data)
+        to = r.raw(ADDRESS_BYTES)
+        value = r.u256()
+        nonce = r.u64()
+        gas_price = r.u256()
+        gas_limit = r.u64()
+        invocation = r.bytes_()
+        r.assert_eof()
+        return cls(to, value, nonce, gas_price, gas_limit, invocation)
+
+    def signing_hash(self, chain_id: int) -> bytes:
+        """Hash to sign — chain id mixed in (EIP-155 shape,
+        reference TransactionUtils.cs)."""
+        return keccak256(self.encode() + write_u64(chain_id))
+
+
+@dataclass(frozen=True)
+class SignedTransaction:
+    tx: Transaction
+    signature: bytes  # 65-byte recoverable ECDSA
+
+    def encode(self) -> bytes:
+        return write_bytes(self.tx.encode()) + write_bytes(self.signature)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedTransaction":
+        r = Reader(data)
+        tx = Transaction.decode(r.bytes_())
+        sig = r.bytes_()
+        r.assert_eof()
+        return cls(tx, sig)
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+    def sender(self, chain_id: int) -> Optional[bytes]:
+        """Recovered 20-byte sender address, or None if invalid."""
+        pub = ecdsa.recover_hash(self.tx.signing_hash(chain_id), self.signature)
+        if pub is None:
+            return None
+        return ecdsa.address_from_public_key(pub)
+
+
+def sign_transaction(
+    tx: Transaction, priv: bytes, chain_id: int
+) -> SignedTransaction:
+    return SignedTransaction(
+        tx=tx, signature=ecdsa.sign_hash(priv, tx.signing_hash(chain_id))
+    )
+
+
+@dataclass(frozen=True)
+class TransactionReceipt:
+    """Execution result (reference: TransactionReceipt in transaction.proto +
+    event.proto logs)."""
+
+    tx_hash: bytes
+    block_index: int
+    index_in_block: int
+    gas_used: int
+    status: int  # 1 success, 0 failed
+    sender: bytes = ZERO_ADDRESS
+    return_data: bytes = b""
+
+    def encode(self) -> bytes:
+        return (
+            self.tx_hash
+            + write_u64(self.block_index)
+            + write_u32(self.index_in_block)
+            + write_u64(self.gas_used)
+            + write_u32(self.status)
+            + self.sender
+            + write_bytes(self.return_data)
+        )
+
+
+@dataclass(frozen=True)
+class BlockHeader:
+    """Reference: block.proto BlockHeader (prev hash, merkle root, state hash,
+    index, nonce)."""
+
+    index: int
+    prev_block_hash: bytes
+    merkle_root: bytes  # over tx hashes
+    state_hash: bytes
+    nonce: int  # from the era's common coin (RootProtocol.cs:316-322)
+
+    def encode(self) -> bytes:
+        return (
+            write_u64(self.index)
+            + self.prev_block_hash
+            + self.merkle_root
+            + self.state_hash
+            + write_u64(self.nonce)
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockHeader":
+        r = Reader(data)
+        index = r.u64()
+        prev_h = r.raw(32)
+        mroot = r.raw(32)
+        shash = r.raw(32)
+        nonce = r.u64()
+        r.assert_eof()
+        return cls(index, prev_h, mroot, shash, nonce)
+
+    def hash(self) -> bytes:
+        return keccak256(self.encode())
+
+
+@dataclass(frozen=True)
+class MultiSig:
+    """Quorum of validator header signatures (reference: multisig.proto)."""
+
+    signatures: Tuple[Tuple[int, bytes], ...]  # (validator index, ecdsa sig)
+
+    def encode(self) -> bytes:
+        out = write_u32(len(self.signatures))
+        for idx, sig in self.signatures:
+            out += write_u32(idx) + write_bytes(sig)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "MultiSig":
+        r = Reader(data)
+        n = r.u32()
+        sigs = tuple((r.u32(), r.bytes_()) for _ in range(n))
+        r.assert_eof()
+        return cls(sigs)
+
+
+@dataclass(frozen=True)
+class Block:
+    header: BlockHeader
+    tx_hashes: Tuple[bytes, ...]
+    multisig: MultiSig
+
+    def encode(self) -> bytes:
+        return (
+            write_bytes(self.header.encode())
+            + write_bytes_list(list(self.tx_hashes))
+            + write_bytes(self.multisig.encode())
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        r = Reader(data)
+        header = BlockHeader.decode(r.bytes_())
+        tx_hashes = tuple(r.bytes_list())
+        multisig = MultiSig.decode(r.bytes_())
+        r.assert_eof()
+        return cls(header, tx_hashes, multisig)
+
+    def hash(self) -> bytes:
+        return self.header.hash()
+
+
+def tx_merkle_root(tx_hashes: Sequence[bytes]) -> bytes:
+    return merkle_root(list(tx_hashes)) or ZERO_HASH

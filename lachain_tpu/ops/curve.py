@@ -74,11 +74,14 @@ def scalars_to_bits(scalars, nbits: int = 256) -> np.ndarray:
 
 
 def g1_inf_like(p):
-    """Infinity point(s) with the same batch shape as p."""
-    x = jnp.zeros_like(p[..., 0, :])
-    y = jnp.broadcast_to(fp.ONE_MONT, p[..., 1, :].shape)
-    z = jnp.zeros_like(p[..., 2, :])
-    return jnp.stack([x, y, z], axis=-2)
+    """Infinity point(s) with the same batch shape as p.
+
+    Derived from p (not fresh constants) so the varying-axes type matches p
+    under shard_map — required when used as a lax.scan carry init.
+    """
+    x = p[..., 0, :] * 0
+    y = x + fp.ONE_MONT
+    return jnp.stack([x, y, x], axis=-2)
 
 
 def g1_is_inf(p):
@@ -168,13 +171,19 @@ def g1_scalar_mul_bits(points, bits):
 
 
 def g1_reduce_sum(points):
-    """Tree-reduce a batch of points (n, 3, L) -> (3, L) via g1_add.
+    """Tree-reduce points over axis 0: (n, ..., 3, L) -> (..., 3, L).
 
-    n must be a power of two (pad with infinity host-side).
+    Any n >= 1 and any intermediate batch axes: odd levels are padded with an
+    infinity row (statically, at trace time) so no share is ever dropped.
     """
     n = points.shape[0]
-    assert n & (n - 1) == 0, "g1_reduce_sum needs a power-of-two batch"
+    assert n >= 1
     while n > 1:
+        if n % 2:
+            points = jnp.concatenate(
+                [points, g1_inf_like(points[:1])], axis=0
+            )
+            n += 1
         half = n // 2
         points = g1_add(points[:half], points[half:n])
         n = half
@@ -257,9 +266,8 @@ def g2_from_device(arr) -> list:
 
 
 def g2_inf_like(p):
-    res = jnp.zeros_like(p)
-    one = jnp.broadcast_to(fp.ONE_MONT, p[..., 1, 0, :].shape)
-    return res.at[..., 1, 0, :].set(one)
+    res = p * 0  # derived from p: keeps shard_map varying-axes type
+    return res.at[..., 1, 0, :].add(fp.ONE_MONT)
 
 
 def g2_is_inf(p):
@@ -339,9 +347,15 @@ def g2_scalar_mul_bits(points, bits):
 
 
 def g2_reduce_sum(points):
+    """Tree-reduce over axis 0 (any n; odd levels padded with infinity)."""
     n = points.shape[0]
-    assert n & (n - 1) == 0
+    assert n >= 1
     while n > 1:
+        if n % 2:
+            points = jnp.concatenate(
+                [points, g2_inf_like(points[:1])], axis=0
+            )
+            n += 1
         half = n // 2
         points = g2_add(points[:half], points[half:n])
         n = half

@@ -93,9 +93,10 @@ def _ripple(t):
         cur = ti + carry
         return cur >> BASE, cur & MASK
 
-    carry, outs = lax.scan(
-        step, jnp.zeros(t.shape[:-1], dtype=jnp.int32), tt
-    )
+    # init carry derived from the input so its varying-axes type matches the
+    # scan output under shard_map manual axes
+    carry0 = tt[0] & 0
+    carry, outs = lax.scan(step, carry0, tt)
     return jnp.moveaxis(outs, 0, -1), carry
 
 

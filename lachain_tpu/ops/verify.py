@@ -51,6 +51,38 @@ def tpke_era_step(u_pts, y_pts, rlc_bits, lagrange_bits):
 tpke_era_step_jit = jax.jit(tpke_era_step)
 
 
+def tpke_era_slots_step(u_pts, y_pts, rlc_bits, lagrange_bits):
+    """Full-era kernel: S ACS slots x K shares each, all at once.
+
+    Args:
+      u_pts:         (S, K, 3, L) decryption shares per slot
+      y_pts:         (S, K, 3, L) verification keys per slot
+      rlc_bits:      (S, K, nbits) per-slot RLC coefficients
+      lagrange_bits: (S, K, nbits) per-slot Lagrange coefficients (zero rows
+                     for shares outside the combination subset)
+
+    Returns (u_agg, y_agg, combined), each (S, 3, L): per-slot aggregates.
+    The host finishes with one 2-pairing check per slot (shared final exp via
+    the native backend's multi-pairing) — versus the reference's 2 pairings
+    per SHARE (2*S*K total).
+
+    This is the flagship "forward step" the driver compile-checks via
+    __graft_entry__ and bench.py times on real TPU hardware.
+    """
+    mul_rlc = curve.g1_scalar_mul_bits(u_pts, rlc_bits)      # (S, K, 3, L)
+    mul_y = curve.g1_scalar_mul_bits(y_pts, rlc_bits)
+    mul_lag = curve.g1_scalar_mul_bits(u_pts, lagrange_bits)
+
+    def reduce_axis1(pts):
+        # tree-reduce the share axis; g1_add broadcasts over the slot axis
+        return curve.g1_reduce_sum(jnp.moveaxis(pts, 1, 0))  # (K, S, 3, L)
+
+    return reduce_axis1(mul_rlc), reduce_axis1(mul_y), reduce_axis1(mul_lag)
+
+
+tpke_era_slots_step_jit = jax.jit(tpke_era_slots_step)
+
+
 class TpuTpkeVerifier:
     """Host-side wrapper: marshals oracle-format shares to the device kernel
     and finishes with 2 native pairings.

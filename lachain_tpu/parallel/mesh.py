@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..ops import curve
 
@@ -54,6 +54,7 @@ def sharded_g1_msm(mesh: Mesh, axis: str = "shares"):
         mesh=mesh,
         in_specs=(P(axis, None, None), P(axis, None)),
         out_specs=P(),  # replicated
+        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -69,6 +70,63 @@ def sharded_g2_msm(mesh: Mesh, axis: str = "shares"):
         mesh=mesh,
         in_specs=(P(axis, None, None, None), P(axis, None)),
         out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_era_mesh(n_devices: int) -> Mesh:
+    """2-D mesh for the era kernel: 'slot' = data-parallel over ACS slots,
+    'share' = sequence-parallel over the within-slot share axis (the
+    framework's dp x sp analog — SURVEY.md §5 maps the reference's
+    protocol-thread fan-out onto exactly these two axes)."""
+    devs = jax.devices()[:n_devices]
+    if n_devices >= 4 and n_devices % 2 == 0:
+        shape = (n_devices // 2, 2)
+    else:
+        shape = (n_devices, 1)
+    return Mesh(np.array(devs).reshape(shape), ("slot", "share"))
+
+
+def sharded_era_step(mesh: Mesh):
+    """shard_map the full era kernel over a ('slot', 'share') mesh.
+
+    Slots shard data-parallel (no cross-device traffic); the share axis
+    shards within each slot, so per-device partial point-sums are combined
+    with an all_gather over 'share' followed by a replicated point-add — the
+    explicit-collective pattern for non-arithmetic reductions (point addition
+    is not a psum).
+    """
+    from ..ops import verify as V
+    from ..ops import curve as C
+
+    def local_step(u, y, rlc, lag):
+        u_agg, y_agg, comb = V.tpke_era_slots_step(u, y, rlc, lag)
+        # (S_local, 3, L) partial sums over the local share shard
+        def combine(pts):
+            gathered = jax.lax.all_gather(pts, "share")  # (nshare, S_l, 3, L)
+            return C.g1_reduce_sum(gathered)
+
+        return combine(u_agg), combine(y_agg), combine(comb)
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(
+            P("slot", "share", None, None),
+            P("slot", "share", None, None),
+            P("slot", "share", None),
+            P("slot", "share", None),
+        ),
+        out_specs=(
+            P("slot", None, None),
+            P("slot", None, None),
+            P("slot", None, None),
+        ),
+        # outputs ARE replicated over 'share' (all_gather + identical local
+        # reduce on every device) but the static varying-axes checker cannot
+        # infer that through the point-add tree
+        check_vma=False,
     )
     return jax.jit(fn)
 

@@ -1,0 +1,152 @@
+"""Key-value storage backends.
+
+Parity with the reference's RocksDB context
+(/root/reference/src/Lachain.Storage/RocksDbContext.cs:23-60 — single KV
+store, WAL-synced writes, atomic batches) and the 2-byte keyspace prefixes
+(EntryPrefix.cs:13-79).
+
+Backends:
+  * MemoryKV  — dict-backed, for tests and the in-process devnet.
+  * SqliteKV  — durable single-file store with atomic batch commit (WAL mode);
+    fills RocksDB's role until the native C++ LSM backend lands (the storage
+    engine is deliberately behind this seam so swapping it touches nothing
+    above).
+"""
+from __future__ import annotations
+
+import enum
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class EntryPrefix(enum.IntEnum):
+    """2-byte keyspace partition (reference EntryPrefix.cs)."""
+
+    BLOCK_BY_HASH = 0x0101
+    BLOCK_HASH_BY_HEIGHT = 0x0102
+    BLOCK_HEIGHT = 0x0103
+    TRANSACTION_BY_HASH = 0x0201
+    TRIE_NODE = 0x0301
+    SNAPSHOT_INDEX = 0x0401
+    POOL_TX = 0x0501
+    KEYGEN_STATE = 0x0601
+    VALIDATOR_ATTENDANCE = 0x0701
+    LOCAL_TRANSACTION = 0x0801
+    CONSENSUS_STATE = 0x0901
+
+
+def prefixed(prefix: EntryPrefix, key: bytes = b"") -> bytes:
+    return int(prefix).to_bytes(2, "big") + key
+
+
+class KVStore:
+    """Interface (reference IRocksDbContext shape)."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def write_batch(self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()) -> None:
+        """Atomic multi-write (reference RocksDBAtomicWrite.cs:1-39)."""
+        raise NotImplementedError
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryKV(KVStore):
+    def __init__(self):
+        self._d: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._d.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._d[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._d.pop(key, None)
+
+    def write_batch(self, puts, deletes=()) -> None:
+        with self._lock:
+            for k, v in puts:
+                self._d[k] = v
+            for k in deletes:
+                self._d.pop(k, None)
+
+    def scan_prefix(self, prefix: bytes):
+        for k in sorted(self._d):
+            if k.startswith(prefix):
+                yield k, self._d[k]
+
+
+class SqliteKV(KVStore):
+    """Durable KV on sqlite (WAL journaling ~ RocksDB WAL-sync semantics)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+        )
+        self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (key,)
+            ).fetchone()
+        return row[0] if row else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (key, value)
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def write_batch(self, puts, deletes=()) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.executemany(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", list(puts)
+            )
+            if deletes:
+                cur.executemany(
+                    "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
+                )
+            self._conn.commit()
+
+    def scan_prefix(self, prefix: bytes):
+        hi = prefix + b"\xff" * 8
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k",
+                (prefix, hi),
+            ).fetchall()
+        for k, v in rows:
+            if bytes(k).startswith(prefix):
+                yield bytes(k), bytes(v)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

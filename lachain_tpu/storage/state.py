@@ -1,0 +1,167 @@
+"""State snapshot model: balances / contracts / storage / tx / events /
+validators over the content-addressed trie.
+
+Parity with the reference's 3-tier snapshot machinery
+(/root/reference/src/Lachain.Storage/State/StateManager.cs:8-21 —
+Committed / Approved / Pending; BlockchainSnapshot.cs aggregating 7
+sub-snapshots; SnapshotManager approve/rollback/commit).
+
+Redesign: because trie roots are immutable content-addressed values
+(storage/trie.py), a snapshot is just a struct of root hashes + a write
+buffer. "Approve" freezes the buffer into new roots; "commit" persists the
+root set under the block height (SnapshotIndexRepository.cs role); "rollback"
+is dropping the struct. No global mutex, no mutable tier state — the
+functional idiom the TPU stack already uses.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.serialization import Reader, write_bytes, write_u64
+from .kv import EntryPrefix, KVStore, prefixed
+from .trie import EMPTY_ROOT, Trie
+
+SUBTREES = (
+    "balances",
+    "contracts",
+    "storage",
+    "transactions",
+    "blocks",
+    "events",
+    "validators",
+)
+
+
+@dataclass(frozen=True)
+class StateRoots:
+    """The 7 sub-roots; the block's state hash commits to all of them
+    (reference: BlockchainSnapshot's sub-snapshot hash aggregation)."""
+
+    balances: bytes = EMPTY_ROOT
+    contracts: bytes = EMPTY_ROOT
+    storage: bytes = EMPTY_ROOT
+    transactions: bytes = EMPTY_ROOT
+    blocks: bytes = EMPTY_ROOT
+    events: bytes = EMPTY_ROOT
+    validators: bytes = EMPTY_ROOT
+
+    def state_hash(self) -> bytes:
+        from ..crypto.hashes import keccak256
+
+        return keccak256(b"".join(getattr(self, name) for name in SUBTREES))
+
+    def encode(self) -> bytes:
+        return b"".join(getattr(self, name) for name in SUBTREES)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StateRoots":
+        assert len(data) == 32 * len(SUBTREES)
+        return cls(**{
+            name: data[i * 32 : (i + 1) * 32] for i, name in enumerate(SUBTREES)
+        })
+
+
+class Snapshot:
+    """Mutable working snapshot on top of immutable roots.
+
+    Writes buffer in-memory; `freeze()` flushes them into the trie and
+    returns new immutable StateRoots. Reads see buffered writes first
+    (the reference's Pending tier).
+    """
+
+    def __init__(self, trie: Trie, roots: StateRoots):
+        self._trie = trie
+        self.base = roots
+        self._writes: Dict[str, Dict[bytes, Optional[bytes]]] = {
+            name: {} for name in SUBTREES
+        }
+
+    # -- typed access --------------------------------------------------------
+    def get(self, tree: str, key: bytes) -> Optional[bytes]:
+        buf = self._writes[tree]
+        if key in buf:
+            return buf[key]
+        return self._trie.get(getattr(self.base, tree), key)
+
+    def put(self, tree: str, key: bytes, value: bytes) -> None:
+        self._writes[tree][key] = value
+
+    def delete(self, tree: str, key: bytes) -> None:
+        self._writes[tree][key] = None
+
+    def freeze(self) -> StateRoots:
+        """Flush buffered writes -> new immutable roots (Approve)."""
+        new_roots = {}
+        for name in SUBTREES:
+            root = getattr(self.base, name)
+            for key, value in sorted(self._writes[name].items()):
+                if value is None:
+                    root = self._trie.delete(root, key)
+                else:
+                    root = self._trie.put(root, key, value)
+            new_roots[name] = root
+        return StateRoots(**new_roots)
+
+    def discard(self) -> None:
+        """Rollback: drop buffered writes."""
+        for name in SUBTREES:
+            self._writes[name].clear()
+
+
+class StateManager:
+    """Committed-chain state keeper
+    (reference: State/StateManager.cs + SnapshotIndexRepository.cs:1-104)."""
+
+    def __init__(self, kv: KVStore):
+        self._kv = kv
+        self.trie = Trie(kv)
+        self._committed: StateRoots = self._load_latest()
+
+    # -- tiers ---------------------------------------------------------------
+    @property
+    def committed(self) -> StateRoots:
+        return self._committed
+
+    def new_snapshot(self, base: Optional[StateRoots] = None) -> Snapshot:
+        return Snapshot(self.trie, base or self._committed)
+
+    def commit(self, height: int, roots: StateRoots) -> None:
+        """Persist roots as the canonical state for `height` (checkpoint —
+        every block is a checkpoint, SURVEY.md §5)."""
+        self._kv.write_batch(
+            [
+                (
+                    prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(height)),
+                    roots.encode(),
+                ),
+                (prefixed(EntryPrefix.BLOCK_HEIGHT), write_u64(height)),
+            ]
+        )
+        self._committed = roots
+
+    def roots_at(self, height: int) -> Optional[StateRoots]:
+        enc = self._kv.get(prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(height)))
+        return StateRoots.decode(enc) if enc else None
+
+    def rollback_to(self, height: int) -> StateRoots:
+        """Restore an older checkpoint (reference --RollBackTo,
+        Application.cs:119-127)."""
+        roots = self.roots_at(height)
+        if roots is None:
+            raise KeyError(f"no snapshot at height {height}")
+        self._kv.put(prefixed(EntryPrefix.BLOCK_HEIGHT), write_u64(height))
+        self._committed = roots
+        return roots
+
+    def committed_height(self) -> Optional[int]:
+        enc = self._kv.get(prefixed(EntryPrefix.BLOCK_HEIGHT))
+        return Reader(enc).u64() if enc else None
+
+    def _load_latest(self) -> StateRoots:
+        h = self.committed_height()
+        if h is None:
+            return StateRoots()
+        roots = self.roots_at(h)
+        return roots if roots is not None else StateRoots()

@@ -1,0 +1,213 @@
+"""Content-addressed 16-ary Merkle trie — the authenticated state store.
+
+Parity with the reference's versioned trie
+(/root/reference/src/Lachain.Storage/Trie/TrieHashMap.cs:17-180,
+InternalNode.cs:1-135, NodeSerializer.cs): 16-ary branching over the nibbles
+of keccak256(key) (keys hashed before insert, TrieHashMap.cs:90-98), root
+hash == state hash per repository.
+
+Redesign vs the reference: nodes are CONTENT-ADDRESSED (stored by the hash of
+their canonical encoding) instead of carrying monotone version ids
+(VersionFactory.cs). Structural sharing makes every root a free, immutable
+snapshot: "versions" are simply root hashes, which collapses the reference's
+Committed/Approved/Pending tier machinery into plain values (state.py) and
+makes rollback O(1). An LRU node cache fills the role of TrieHashMap's cache.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..crypto.hashes import keccak256
+from ..utils.serialization import Reader, write_bytes, write_u16, write_u32
+from .kv import EntryPrefix, KVStore, prefixed
+
+EMPTY_ROOT = b"\x00" * 32
+_NIBBLES = 64  # keccak256 -> 64 nibbles
+
+
+def _nibble(h: bytes, depth: int) -> int:
+    byte = h[depth // 2]
+    return (byte >> 4) if depth % 2 == 0 else (byte & 0x0F)
+
+
+@dataclass(frozen=True)
+class LeafNode:
+    key_hash: bytes  # full 32-byte hashed key
+    value: bytes
+
+    def encode(self) -> bytes:
+        return b"L" + self.key_hash + write_bytes(self.value)
+
+
+@dataclass(frozen=True)
+class InternalNode:
+    # 16 child hashes (EMPTY_ROOT = no child) — mask+list on the wire like the
+    # reference's children-mask encoding (InternalNode.cs)
+    children: Tuple[bytes, ...]
+
+    def encode(self) -> bytes:
+        mask = 0
+        present = []
+        for i, c in enumerate(self.children):
+            if c != EMPTY_ROOT:
+                mask |= 1 << i
+                present.append(c)
+        return b"I" + write_u16(mask) + b"".join(present)
+
+
+def _decode(data: bytes):
+    if data[0:1] == b"L":
+        r = Reader(data[33:])
+        return LeafNode(key_hash=data[1:33], value=r.bytes_())
+    if data[0:1] == b"I":
+        mask = int.from_bytes(data[1:3], "big")
+        children = []
+        off = 3
+        for i in range(16):
+            if mask & (1 << i):
+                children.append(data[off : off + 32])
+                off += 32
+            else:
+                children.append(EMPTY_ROOT)
+        return InternalNode(tuple(children))
+    raise ValueError("bad trie node encoding")
+
+
+class Trie:
+    """Handle over a KV store; every mutation returns a NEW root hash."""
+
+    def __init__(self, kv: KVStore, cache_size: int = 65536):
+        self._kv = kv
+        self._cache: OrderedDict[bytes, object] = OrderedDict()
+        self._cache_size = cache_size
+
+    # -- node io -------------------------------------------------------------
+    def _store(self, node) -> bytes:
+        enc = node.encode()
+        h = keccak256(enc)
+        self._kv.put(prefixed(EntryPrefix.TRIE_NODE, h), enc)
+        self._cache_put(h, node)
+        return h
+
+    def _load(self, h: bytes):
+        node = self._cache.get(h)
+        if node is not None:
+            self._cache.move_to_end(h)
+            return node
+        enc = self._kv.get(prefixed(EntryPrefix.TRIE_NODE, h))
+        if enc is None:
+            raise KeyError(f"missing trie node {h.hex()}")
+        node = _decode(enc)
+        self._cache_put(h, node)
+        return node
+
+    def _cache_put(self, h: bytes, node) -> None:
+        self._cache[h] = node
+        if len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+
+    # -- public api ----------------------------------------------------------
+    def get(self, root: bytes, key: bytes) -> Optional[bytes]:
+        if root == EMPTY_ROOT:
+            return None
+        kh = keccak256(key)
+        node_hash = root
+        depth = 0
+        while True:
+            node = self._load(node_hash)
+            if isinstance(node, LeafNode):
+                return node.value if node.key_hash == kh else None
+            nxt = node.children[_nibble(kh, depth)]
+            if nxt == EMPTY_ROOT:
+                return None
+            node_hash = nxt
+            depth += 1
+
+    def put(self, root: bytes, key: bytes, value: bytes) -> bytes:
+        kh = keccak256(key)
+        return self._put_hashed(root, kh, value, 0)
+
+    def _put_hashed(self, node_hash: bytes, kh: bytes, value: bytes, depth: int) -> bytes:
+        if node_hash == EMPTY_ROOT:
+            return self._store(LeafNode(kh, value))
+        node = self._load(node_hash)
+        if isinstance(node, LeafNode):
+            if node.key_hash == kh:
+                return self._store(LeafNode(kh, value))
+            # split: push the existing leaf down until paths diverge
+            children = [EMPTY_ROOT] * 16
+            old_nib = _nibble(node.key_hash, depth)
+            new_nib = _nibble(kh, depth)
+            if old_nib == new_nib:
+                sub = self._put_hashed(
+                    self._store(node), kh, value, depth + 1
+                )
+                children[old_nib] = sub
+            else:
+                children[old_nib] = self._store(node)
+                children[new_nib] = self._store(LeafNode(kh, value))
+            return self._store(InternalNode(tuple(children)))
+        nib = _nibble(kh, depth)
+        new_child = self._put_hashed(node.children[nib], kh, value, depth + 1)
+        children = list(node.children)
+        children[nib] = new_child
+        return self._store(InternalNode(tuple(children)))
+
+    def delete(self, root: bytes, key: bytes) -> bytes:
+        kh = keccak256(key)
+        new_root = self._del_hashed(root, kh, 0)
+        return new_root if new_root is not None else root
+
+    def _del_hashed(self, node_hash: bytes, kh: bytes, depth: int) -> Optional[bytes]:
+        """Returns the new subtree hash, EMPTY_ROOT if emptied, or None if
+        the key was absent (no change)."""
+        if node_hash == EMPTY_ROOT:
+            return None
+        node = self._load(node_hash)
+        if isinstance(node, LeafNode):
+            return EMPTY_ROOT if node.key_hash == kh else None
+        nib = _nibble(kh, depth)
+        sub = self._del_hashed(node.children[nib], kh, depth + 1)
+        if sub is None:
+            return None
+        children = list(node.children)
+        children[nib] = sub
+        live = [c for c in children if c != EMPTY_ROOT]
+        if not live:
+            return EMPTY_ROOT
+        if len(live) == 1:
+            only = self._load(live[0])
+            if isinstance(only, LeafNode):
+                return self._store(only)  # collapse single-leaf branch
+        return self._store(InternalNode(tuple(children)))
+
+    def iter_items(self, root: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        """All (hashed_key, value) pairs under a root (ordered by key hash)."""
+        if root == EMPTY_ROOT:
+            return
+        stack = [root]
+        while stack:
+            node = self._load(stack.pop())
+            if isinstance(node, LeafNode):
+                yield node.key_hash, node.value
+            else:
+                for c in reversed(node.children):
+                    if c != EMPTY_ROOT:
+                        stack.append(c)
+
+    def node_count(self, root: bytes) -> int:
+        if root == EMPTY_ROOT:
+            return 0
+        seen = set()
+        stack = [root]
+        while stack:
+            h = stack.pop()
+            if h in seen:
+                continue
+            seen.add(h)
+            node = self._load(h)
+            if isinstance(node, InternalNode):
+                stack.extend(c for c in node.children if c != EMPTY_ROOT)
+        return len(seen)

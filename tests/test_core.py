@@ -1,0 +1,194 @@
+"""Core chain tests: types, execution, pool, block manager, devnet e2e.
+
+Mirrors the reference's core integration suites
+(test/Lachain.CoreTest/IntegrationTests/BlocksTest.cs, TransactionsTest.cs)
+— but in-process against the functional state, plus the full 4-validator
+devnet producing blocks through real HoneyBadger consensus (the reference
+only has this as a manual docker-compose flow, SURVEY.md §4.5).
+"""
+import random
+
+import pytest
+
+from lachain_tpu.core import execution
+from lachain_tpu.core.block_manager import BlockManager
+from lachain_tpu.core.devnet import DEFAULT_CHAIN_ID, Devnet
+from lachain_tpu.core.tx_pool import TransactionPool
+from lachain_tpu.core.types import (
+    Block,
+    BlockHeader,
+    MultiSig,
+    SignedTransaction,
+    Transaction,
+    sign_transaction,
+)
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.storage.kv import MemoryKV
+from lachain_tpu.storage.state import StateManager
+
+
+class Rng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+CHAIN = DEFAULT_CHAIN_ID
+
+
+def _account(seed):
+    priv = ecdsa.generate_private_key(Rng(seed))
+    addr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(priv))
+    return priv, addr
+
+
+def _tx(priv, to, value, nonce, gas_price=1):
+    tx = Transaction(
+        to=to, value=value, nonce=nonce, gas_price=gas_price, gas_limit=100000
+    )
+    return sign_transaction(tx, priv, CHAIN)
+
+
+def test_transaction_wire_roundtrip():
+    priv, addr = _account(1)
+    stx = _tx(priv, b"\x02" * 20, 123, 0)
+    back = SignedTransaction.decode(stx.encode())
+    assert back == stx
+    assert back.sender(CHAIN) == addr
+    # chain-id binding: different chain id -> different signer recovered
+    assert back.sender(CHAIN + 1) != addr
+
+
+def _fresh_chain(balances):
+    kv = MemoryKV()
+    state = StateManager(kv)
+    bm = BlockManager(kv, state, execution.TransactionExecuter(CHAIN))
+    bm.build_genesis(balances, CHAIN)
+    return kv, state, bm
+
+
+def test_execution_transfer_and_failures():
+    priv_a, a = _account(2)
+    _, b = _account(3)
+    kv, state, bm = _fresh_chain({a: 10**18})
+    fee = execution.GAS_PER_TX
+
+    txs = [
+        _tx(priv_a, b, 1000, 0),          # ok
+        _tx(priv_a, b, 2000, 1),          # ok
+        _tx(priv_a, b, 5000, 5),          # bad nonce -> failed receipt
+        _tx(priv_a, b, 10**19, 2),        # insufficient balance -> failed
+    ]
+    em = bm.emulate(txs, 1)
+    statuses = [r.status for r in em.receipts]
+    assert statuses == [1, 1, 0, 0]
+    snap = state.new_snapshot(em.roots)
+    assert execution.get_balance(snap, b) == 3000
+    assert execution.get_balance(snap, a) == 10**18 - 3000 - 2 * fee
+    assert execution.get_nonce(snap, a) == 2
+
+
+def test_emulate_does_not_mutate_committed_state():
+    priv_a, a = _account(4)
+    _, b = _account(5)
+    kv, state, bm = _fresh_chain({a: 10**18})
+    before = state.committed.state_hash()
+    bm.emulate([_tx(priv_a, b, 1, 0)], 1)
+    assert state.committed.state_hash() == before
+
+
+def test_pool_ordering_and_nonce_continuity():
+    priv_a, a = _account(6)
+    priv_b, b = _account(7)
+    kv, state, bm = _fresh_chain({a: 10**18, b: 10**18})
+    pool = TransactionPool(
+        kv,
+        CHAIN,
+        account_nonce=lambda addr: execution.get_nonce(
+            state.new_snapshot(), addr
+        ),
+    )
+    assert pool.add(_tx(priv_a, b, 1, 0, gas_price=5))
+    assert pool.add(_tx(priv_a, b, 1, 1, gas_price=5))
+    assert pool.add(_tx(priv_a, b, 1, 3, gas_price=9))  # nonce gap: unexecutable
+    assert pool.add(_tx(priv_b, a, 1, 0, gas_price=7))
+    picked = pool.peek(10)
+    # nonce-3 tx must be excluded; b's higher-fee tx first
+    nonces_a = [t.tx.nonce for t in picked if t.sender(CHAIN) == a]
+    assert nonces_a == [0, 1]
+    assert picked[0].sender(CHAIN) == b
+    # duplicate rejected; lower-fee replacement rejected
+    assert not pool.add(_tx(priv_b, a, 1, 0, gas_price=7))
+    assert not pool.add(_tx(priv_b, a, 1, 0, gas_price=6))
+    # higher-fee replacement accepted
+    assert pool.add(_tx(priv_b, a, 1, 0, gas_price=8))
+
+
+def test_pool_restore(tmp_path):
+    priv_a, a = _account(8)
+    _, b = _account(9)
+    kv, state, bm = _fresh_chain({a: 10**18})
+    nonce_fn = lambda addr: execution.get_nonce(state.new_snapshot(), addr)
+    pool = TransactionPool(kv, CHAIN, account_nonce=nonce_fn)
+    pool.add(_tx(priv_a, b, 1, 0))
+    pool2 = TransactionPool(kv, CHAIN, account_nonce=nonce_fn)
+    assert pool2.restore() == 1
+    assert len(pool2) == 1
+
+
+def test_block_execute_rejects_wrong_state_hash():
+    priv_a, a = _account(10)
+    _, b = _account(11)
+    kv, state, bm = _fresh_chain({a: 10**18})
+    genesis = bm.block_by_height(0)
+    header = BlockHeader(
+        index=1,
+        prev_block_hash=genesis.hash(),
+        merkle_root=b"\x00" * 32,
+        state_hash=b"\x11" * 32,  # wrong
+        nonce=0,
+    )
+    with pytest.raises(ValueError, match="state hash"):
+        bm.execute_block(header, [], MultiSig(()))
+
+
+# ---------------------------------------------------------------------------
+# Devnet end-to-end: the "minimum end-to-end slice" of SURVEY.md §7 step 4
+# ---------------------------------------------------------------------------
+
+
+def test_devnet_produces_blocks():
+    priv_a, a = _account(20)
+    _, b = _account(21)
+    net = Devnet(n=4, f=1, seed=5, initial_balances={a: 10**18})
+    # empty era first
+    blocks = net.run_era(1)
+    assert all(blk.header.index == 1 for blk in blocks)
+    assert net.height() == 1
+
+    # now a real transfer through consensus
+    assert net.submit_tx(_tx(priv_a, b, 12345, 0))
+    net.run_era(2)
+    assert net.height() == 2
+    for i in range(4):
+        assert net.balance(b, node=i) == 12345
+    # tx removed from every pool
+    assert all(len(n.pool) == 0 for n in net.nodes)
+
+
+def test_devnet_multiple_eras_state_convergence():
+    priv_a, a = _account(22)
+    _, b = _account(23)
+    net = Devnet(n=4, f=1, seed=6, initial_balances={a: 10**18})
+    for era in range(1, 4):
+        net.submit_tx(_tx(priv_a, b, 100, era - 1))
+        net.run_era(era)
+    assert net.height() == 3
+    # all nodes agree on final state hash
+    hashes = {
+        n.state.committed.state_hash() for n in net.nodes
+    }
+    assert len(hashes) == 1
+    assert net.balance(b) == 300

@@ -135,6 +135,19 @@ def test_g2_ops_match_oracle():
         assert bls.g2_eq(muls[i], bls.g2_mul(pts[i], scalars[i])), i
 
 
+def test_g1_reduce_sum_odd_counts():
+    """Regression: non-power-of-two batches must not silently drop points."""
+    rng = random.Random(8)
+    for n in (1, 3, 5, 7):
+        pts = _random_g1(rng, n)
+        pd = jnp.asarray(curve.g1_to_device(pts))
+        got = curve.g1_from_device(curve.g1_reduce_sum(pd)[None])[0]
+        expect = bls.G1_INF
+        for p in pts:
+            expect = bls.g1_add(expect, p)
+        assert bls.g1_eq(got, expect), n
+
+
 def test_g1_msm_jits():
     rng = random.Random(7)
     n = 4

@@ -1,0 +1,139 @@
+"""Storage layer tests: KV, content-addressed trie, state snapshots.
+
+Mirrors the reference's storage suites (test/Lachain.StorageTest/RocksDbTest,
+StorageIntergrationTest — trie/state snapshot/rollback/hash consistency).
+"""
+import os
+import random
+import tempfile
+
+import pytest
+
+from lachain_tpu.storage.kv import MemoryKV, SqliteKV
+from lachain_tpu.storage.state import StateManager, StateRoots
+from lachain_tpu.storage.trie import EMPTY_ROOT, Trie
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_kv_roundtrip(backend, tmp_path):
+    kv = MemoryKV() if backend == "memory" else SqliteKV(str(tmp_path / "kv.db"))
+    kv.put(b"a", b"1")
+    kv.put(b"ab", b"2")
+    kv.put(b"b", b"3")
+    assert kv.get(b"a") == b"1"
+    assert kv.get(b"missing") is None
+    assert [(k, v) for k, v in kv.scan_prefix(b"a")] == [
+        (b"a", b"1"),
+        (b"ab", b"2"),
+    ]
+    kv.write_batch([(b"c", b"4"), (b"a", b"9")], deletes=[b"b"])
+    assert kv.get(b"a") == b"9" and kv.get(b"b") is None and kv.get(b"c") == b"4"
+    kv.close()
+
+
+def test_sqlite_kv_persistence(tmp_path):
+    path = str(tmp_path / "kv.db")
+    kv = SqliteKV(path)
+    kv.put(b"key", b"value")
+    kv.close()
+    kv2 = SqliteKV(path)
+    assert kv2.get(b"key") == b"value"
+    kv2.close()
+
+
+def test_trie_basic():
+    trie = Trie(MemoryKV())
+    root = EMPTY_ROOT
+    root = trie.put(root, b"k1", b"v1")
+    root = trie.put(root, b"k2", b"v2")
+    assert trie.get(root, b"k1") == b"v1"
+    assert trie.get(root, b"k2") == b"v2"
+    assert trie.get(root, b"k3") is None
+    # update
+    root2 = trie.put(root, b"k1", b"v1b")
+    assert trie.get(root2, b"k1") == b"v1b"
+    # old root unchanged (structural sharing = free snapshots)
+    assert trie.get(root, b"k1") == b"v1"
+
+
+def test_trie_root_is_insertion_order_independent():
+    """State hash determinism across nodes (SURVEY.md §7 hard part #5)."""
+    rng = random.Random(42)
+    items = [(b"key-%d" % i, b"val-%d" % i) for i in range(200)]
+    roots = []
+    for _ in range(3):
+        shuffled = items[:]
+        rng.shuffle(shuffled)
+        trie = Trie(MemoryKV())
+        root = EMPTY_ROOT
+        for k, v in shuffled:
+            root = trie.put(root, k, v)
+        roots.append(root)
+    assert roots[0] == roots[1] == roots[2]
+
+
+def test_trie_delete():
+    trie = Trie(MemoryKV())
+    root = EMPTY_ROOT
+    root1 = trie.put(root, b"a", b"1")
+    root2 = trie.put(root1, b"b", b"2")
+    root3 = trie.delete(root2, b"b")
+    assert trie.get(root3, b"b") is None
+    assert trie.get(root3, b"a") == b"1"
+    # deleting everything returns to the empty root's semantics
+    root4 = trie.delete(root3, b"a")
+    assert trie.get(root4, b"a") is None
+    # delete of a missing key is a no-op
+    assert trie.delete(root3, b"zzz") == root3
+
+
+def test_trie_many_keys_iter():
+    trie = Trie(MemoryKV())
+    root = EMPTY_ROOT
+    for i in range(500):
+        root = trie.put(root, b"k%d" % i, b"v%d" % i)
+    items = dict(trie.iter_items(root))
+    assert len(items) == 500
+    for i in (0, 123, 499):
+        assert trie.get(root, b"k%d" % i) == b"v%d" % i
+
+
+def test_state_snapshot_commit_rollback():
+    kv = MemoryKV()
+    sm = StateManager(kv)
+    snap = sm.new_snapshot()
+    snap.put("balances", b"alice", b"100")
+    snap.put("storage", b"slot", b"data")
+    roots1 = snap.freeze()
+    sm.commit(1, roots1)
+
+    snap2 = sm.new_snapshot()
+    assert snap2.get("balances", b"alice") == b"100"
+    snap2.put("balances", b"alice", b"50")
+    snap2.put("balances", b"bob", b"50")
+    roots2 = snap2.freeze()
+    sm.commit(2, roots2)
+    assert sm.committed_height() == 2
+
+    # rollback restores the height-1 view (reference --RollBackTo)
+    sm.rollback_to(1)
+    snap3 = sm.new_snapshot()
+    assert snap3.get("balances", b"alice") == b"100"
+    assert snap3.get("balances", b"bob") is None
+    assert sm.committed.state_hash() == roots1.state_hash()
+
+
+def test_snapshot_discard():
+    sm = StateManager(MemoryKV())
+    snap = sm.new_snapshot()
+    snap.put("balances", b"x", b"1")
+    snap.discard()
+    assert snap.freeze().state_hash() == StateRoots().state_hash()
+
+
+def test_state_roots_encoding():
+    sm = StateManager(MemoryKV())
+    snap = sm.new_snapshot()
+    snap.put("events", b"e", b"1")
+    roots = snap.freeze()
+    assert StateRoots.decode(roots.encode()) == roots

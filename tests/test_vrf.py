@@ -1,0 +1,82 @@
+"""ECVRF + stake lottery tests.
+
+Mirrors the reference's VRF usage (ValidatorStatusManager.SubmitVrf flow +
+StakingContract winner checks).
+"""
+import random
+
+from lachain_tpu.crypto import ecdsa as ec
+from lachain_tpu.crypto import vrf
+
+
+class Rng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def test_evaluate_verify_roundtrip():
+    sk = ec.generate_private_key(Rng(1))
+    pk = ec.public_key_bytes(sk)
+    for alpha in (b"", b"seed|cycle=5", b"x" * 100):
+        proof, beta = vrf.evaluate(sk, alpha)
+        assert vrf.verify(pk, alpha, proof)
+        assert vrf.proof_to_hash(proof) == beta
+        assert len(beta) == 32
+
+
+def test_verify_rejects_tampered():
+    sk = ec.generate_private_key(Rng(2))
+    pk = ec.public_key_bytes(sk)
+    proof, _ = vrf.evaluate(sk, b"alpha")
+    # wrong message
+    assert not vrf.verify(pk, b"other", proof)
+    # wrong key
+    sk2 = ec.generate_private_key(Rng(3))
+    assert not vrf.verify(ec.public_key_bytes(sk2), b"alpha", proof)
+    # tampered scalar
+    bad = bytearray(proof)
+    bad[60] ^= 1
+    assert not vrf.verify(pk, b"alpha", bytes(bad))
+    assert not vrf.verify(pk, b"alpha", b"short")
+
+
+def test_vrf_deterministic_and_unpredictable():
+    sk = ec.generate_private_key(Rng(4))
+    p1, b1 = vrf.evaluate(sk, b"a")
+    p2, b2 = vrf.evaluate(sk, b"a")
+    assert p1 == p2 and b1 == b2
+    _, b3 = vrf.evaluate(sk, b"b")
+    assert b3 != b1
+
+
+def test_lottery_statistics():
+    """Win frequency tracks stake share (coarse statistical check)."""
+    rng = random.Random(5)
+    total, seats = 1000, 10
+    wins_small, wins_big = 0, 0
+    trials = 400
+    for i in range(trials):
+        beta = rng.getrandbits(256).to_bytes(32, "big")
+        if vrf.is_winner(beta, 10, total, seats):  # 1% of stake
+            wins_small += 1
+        if vrf.is_winner(beta, 500, total, seats):  # 50% of stake
+            wins_big += 1
+    # P(small) = 1-(0.99)^10 ~ 9.6%; P(big) = 1-(0.99)^500 ~ 99.3%
+    assert 10 <= wins_small <= 80, wins_small
+    assert wins_big >= 370, wins_big
+
+
+def test_lottery_edges():
+    beta = b"\x80" + b"\x00" * 31
+    assert not vrf.is_winner(beta, 0, 1000, 10)
+    assert vrf.is_winner(beta, 1000, 1000, 1000)  # seats == total
+    # deterministic across repeated evaluation
+    assert vrf.is_winner(beta, 50, 1000, 10) == vrf.is_winner(
+        beta, 50, 1000, 10
+    )
+    # huge stake values don't blow up (wei-scale)
+    big = 10**24
+    assert isinstance(vrf.is_winner(beta, big, 4 * big, 22), bool)
