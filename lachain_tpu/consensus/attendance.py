@@ -1,0 +1,77 @@
+"""Validator attendance bookkeeping.
+
+Parity with the reference's ValidatorAttendance
+(/root/reference/src/Lachain.Consensus/ValidatorAttendance.cs:11-127):
+per-cycle counts of blocks each validator co-signed, persisted so the
+staking contract's attendance-detection phase can slash absentees. Tracks a
+two-cycle window (previous + next) and rotates it on cycle advance.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..utils.serialization import Reader, write_bytes, write_u32, write_u64
+
+
+class ValidatorAttendance:
+    def __init__(
+        self,
+        previous_cycle: int,
+        previous: Dict[bytes, int] = None,
+        next_: Dict[bytes, int] = None,
+    ):
+        self.previous_cycle = previous_cycle
+        self.next_cycle = previous_cycle + 1
+        self._previous: Dict[bytes, int] = dict(previous or {})
+        self._next: Dict[bytes, int] = dict(next_ or {})
+
+    def get(self, public_key: bytes, cycle: int) -> int:
+        if cycle == self.previous_cycle:
+            return self._previous.get(public_key, 0)
+        if cycle == self.next_cycle:
+            return self._next.get(public_key, 0)
+        return 0
+
+    def increment(self, public_key: bytes, cycle: int) -> None:
+        if cycle == self.previous_cycle:
+            self._previous[public_key] = self._previous.get(public_key, 0) + 1
+        if cycle == self.next_cycle:
+            self._next[public_key] = self._next.get(public_key, 0) + 1
+
+    def to_bytes(self) -> bytes:
+        out = write_u64(self.previous_cycle)
+        out += write_u32(len(self._previous))
+        for pk, count in self._previous.items():
+            out += write_bytes(pk) + write_u64(count)
+        out += write_u32(len(self._next))
+        for pk, count in self._next.items():
+            out += write_bytes(pk) + write_u64(count)
+        return out
+
+    @classmethod
+    def from_bytes(
+        cls, data: bytes, current_cycle: int, current_as_next: bool
+    ) -> "ValidatorAttendance":
+        """Deserialize, rotating the window to `current_cycle`
+        (reference: ValidatorAttendance.FromBytes:82-119)."""
+        r = Reader(data)
+        previous_cycle = r.u64()
+        previous = {r.bytes_(): r.u64() for _ in range(r.u32())}
+        next_ = {r.bytes_(): r.u64() for _ in range(r.u32())}
+        r.assert_eof()
+        if previous_cycle == current_cycle:
+            return cls(previous_cycle, previous, next_)
+        if previous_cycle == current_cycle - 1 and not current_as_next:
+            return cls(previous_cycle, previous, next_)
+        if previous_cycle == current_cycle - 1 and current_as_next:
+            return cls(current_cycle, next_, {})
+        if previous_cycle == current_cycle - 2 and not current_as_next:
+            return cls(previous_cycle + 1, next_, {})
+        return cls(current_cycle, {}, {})
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ValidatorAttendance)
+            and self.previous_cycle == other.previous_cycle
+            and self._previous == other._previous
+        )

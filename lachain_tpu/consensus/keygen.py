@@ -1,0 +1,517 @@
+"""Trustless distributed key generation (on-chain Joint-Feldman-style DKG).
+
+Functional parity with the reference's DKG
+(/root/reference/src/Lachain.Consensus/ThresholdKeygen/):
+  * TrustlessKeygen       (TrustlessKeygen.cs:36-261) — commit / send-value /
+    confirm lifecycle with full-state serialization for crash-resume
+  * BiVarSymmetricPolynomial (Data/BiVarSymmetricPolynomial.cs:9-58)
+  * Commitment            (Data/Commitment.cs:9-103)
+  * State                 (Data/State.cs:10-103)
+  * ThresholdKeyring      (Data/ThresholdKeyring.cs)
+
+Protocol (messages ride on-chain as governance transactions, so every node
+processes them in the same total order — that block ordering is what makes
+`finished` deterministic across nodes):
+
+  1. Each dealer d samples a random symmetric bivariate polynomial
+     F_d(x, y) of degree f and broadcasts COMMIT: g1^{coeffs} plus, for each
+     player i, ECIES-encrypted row F_d(i+1, ·).
+  2. On COMMIT from d, player i decrypts row_i, checks it against the
+     commitment, and broadcasts VALUE: for each player j, ECIES-encrypted
+     F_d(i+1, j+1).
+  3. On VALUE from sender s for dealer d, player i decrypts F_d(s+1, i+1)
+     and checks it against d's commitment. Dealer d is `finished` once
+     > 2f senders acked. Keygen is finished once > f dealers finished.
+  4. x_i = sum over the first f+1 finished dealers of F_d(0, i+1)
+     (interpolated from the acked values); the shared TPKE/TS secret is
+     P(0) with P(y) = sum_d F_d(0, y). Nodes broadcast CONFIRM with the
+     derived public keyring; at N-f matching confirms the keys go live.
+
+The heavy step — commitment row evaluation, O(N * f^2) G1 scalar muls per
+keygen — is expressed as per-row G1 MSMs over the shared backend, so a
+cycle-boundary keygen burst rides the same batched TPU data plane as the
+per-era share verification (SURVEY.md §2a "centerpiece").
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..crypto import bls12381 as bls
+from ..crypto import ecdsa
+from ..crypto import threshold_sig as ts
+from ..crypto import tpke
+from ..crypto.hashes import keccak256
+from ..crypto.provider import get_backend
+from ..utils.serialization import Reader, write_bytes, write_u32, write_u64
+from .keys import PrivateConsensusKeys, PublicConsensusKeys
+
+
+def _tri_index(i: int, j: int) -> int:
+    """Index into the packed triangular coefficient array (symmetric poly)."""
+    if i > j:
+        i, j = j, i
+    return i * (i + 1) // 2 + j
+
+
+class BiVarSymmetricPolynomial:
+    """Random symmetric bivariate polynomial over Fr, degree f in each var
+    (reference: Data/BiVarSymmetricPolynomial.cs:9-58)."""
+
+    def __init__(self, degree: int, coeffs: Sequence[int]):
+        if len(coeffs) != (degree + 1) * (degree + 2) // 2:
+            raise ValueError("wrong number of coefficients")
+        self.degree = degree
+        self.coeffs = [c % bls.R for c in coeffs]
+
+    @classmethod
+    def random(cls, degree: int, rng=secrets) -> "BiVarSymmetricPolynomial":
+        count = (degree + 1) * (degree + 2) // 2
+        return cls(degree, [rng.randbelow(bls.R) for _ in range(count)])
+
+    def commit(self) -> "Commitment":
+        backend = get_backend()
+        return Commitment(
+            [backend.g1_mul(bls.G1_GEN, c) for c in self.coeffs]
+        )
+
+    def evaluate_row(self, x: int) -> List[int]:
+        """Row polynomial F(x, ·) as f+1 Fr coefficients
+        (reference: BiVarSymmetricPolynomial.Evaluate)."""
+        row = [0] * (self.degree + 1)
+        for i in range(self.degree + 1):
+            x_pow = 1
+            for j in range(self.degree + 1):
+                row[i] = (row[i] + self.coeffs[_tri_index(i, j)] * x_pow) % bls.R
+                x_pow = x_pow * x % bls.R
+        return row
+
+
+class Commitment:
+    """G1 commitment to a symmetric bivariate polynomial
+    (reference: Data/Commitment.cs:9-103)."""
+
+    def __init__(self, coeffs: Sequence[tuple]):
+        self.coeffs = list(coeffs)
+        degree = 0
+        while (degree + 1) * (degree + 2) // 2 < len(self.coeffs):
+            degree += 1
+        if (degree + 1) * (degree + 2) // 2 != len(self.coeffs):
+            raise ValueError("invalid commitment coefficient count")
+        self.degree = degree
+
+    def evaluate_row(self, x: int) -> List[tuple]:
+        """Committed row: [sum_j C[i,j] * x^j for i] — one G1 MSM per row
+        coefficient (reference: Commitment.Evaluate(x))."""
+        backend = get_backend()
+        powers = [pow(x, j, bls.R) for j in range(self.degree + 1)]
+        return [
+            backend.g1_msm(
+                [self.coeffs[_tri_index(i, j)] for j in range(self.degree + 1)],
+                powers,
+            )
+            for i in range(self.degree + 1)
+        ]
+
+    def evaluate(self, x: int, y: int) -> tuple:
+        """Committed point g1^{F(x,y)} as one (f+1)^2 MSM
+        (reference: Commitment.Evaluate(x, y))."""
+        backend = get_backend()
+        pts = []
+        scalars = []
+        for i in range(self.degree + 1):
+            for j in range(self.degree + 1):
+                pts.append(self.coeffs[_tri_index(i, j)])
+                scalars.append(
+                    pow(x, i, bls.R) * pow(y, j, bls.R) % bls.R
+                )
+        return backend.g1_msm(pts, scalars)
+
+    def to_bytes(self) -> bytes:
+        return b"".join(bls.g1_to_bytes(c) for c in self.coeffs)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Commitment":
+        if len(data) % bls.G1_BYTES != 0:
+            raise ValueError("commitment length not a multiple of G1 size")
+        backend = get_backend()
+        return cls(
+            [
+                backend.g1_deserialize(data[o : o + bls.G1_BYTES])
+                for o in range(0, len(data), bls.G1_BYTES)
+            ]
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Commitment)
+            and len(self.coeffs) == len(other.coeffs)
+            and all(
+                bls.g1_eq(a, b) for a, b in zip(self.coeffs, other.coeffs)
+            )
+        )
+
+
+@dataclass
+class CommitMessage:
+    """Dealer broadcast: commitment + per-player encrypted rows
+    (reference: CommitMessage in TrustlessKeygen.cs:63-76)."""
+
+    commitment: Commitment
+    encrypted_rows: List[bytes]
+
+    def to_bytes(self) -> bytes:
+        out = write_bytes(self.commitment.to_bytes())
+        out += write_u32(len(self.encrypted_rows))
+        for row in self.encrypted_rows:
+            out += write_bytes(row)
+        return out
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "CommitMessage":
+        r = Reader(data)
+        commitment = Commitment.from_bytes(r.bytes_())
+        rows = [r.bytes_() for _ in range(r.u32())]
+        r.assert_eof()
+        return cls(commitment, rows)
+
+
+@dataclass
+class ValueMessage:
+    """Player's response to a dealer's commit: encrypted row evaluations
+    (reference: ValueMessage in TrustlessKeygen.cs:101-109)."""
+
+    proposer: int
+    encrypted_values: List[bytes]
+
+    def to_bytes(self) -> bytes:
+        out = write_u32(self.proposer)
+        out += write_u32(len(self.encrypted_values))
+        for v in self.encrypted_values:
+            out += write_bytes(v)
+        return out
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ValueMessage":
+        r = Reader(data)
+        proposer = r.u32()
+        values = [r.bytes_() for _ in range(r.u32())]
+        r.assert_eof()
+        return cls(proposer, values)
+
+
+class KeygenState:
+    """Per-dealer progress (reference: Data/State.cs:10-103)."""
+
+    def __init__(self, n: int):
+        self.commitment: Optional[Commitment] = None
+        self.values: List[int] = [0] * n
+        self.acks: List[bool] = [False] * n
+
+    def value_count(self) -> int:
+        return sum(self.acks)
+
+    def interpolate_values(self) -> int:
+        """F_d(0, my_idx+1): Lagrange-interpolate the first degree+1 acked
+        sender values at 0 (reference: State.InterpolateValues)."""
+        if self.commitment is None:
+            raise ValueError("cannot interpolate without commitment")
+        need = self.commitment.degree + 1
+        xs = [i + 1 for i, a in enumerate(self.acks) if a][:need]
+        ys = [self.values[x - 1] for x in xs]
+        if len(xs) != need:
+            raise ValueError("not enough values to interpolate")
+        return bls.fr_interpolate(xs, ys, at=0)
+
+    def to_bytes(self) -> bytes:
+        commitment = self.commitment.to_bytes() if self.commitment else b""
+        out = write_bytes(commitment)
+        out += write_u32(len(self.acks))
+        out += b"".join(bls.fr_to_bytes(v) for v in self.values)
+        out += bytes(1 if a else 0 for a in self.acks)
+        return out
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KeygenState":
+        r = Reader(data)
+        commitment_bytes = r.bytes_()
+        n = r.u32()
+        state = cls(n)
+        if commitment_bytes:
+            state.commitment = Commitment.from_bytes(commitment_bytes)
+        state.values = [
+            bls.fr_from_bytes(r.raw(bls.FR_BYTES)) for _ in range(n)
+        ]
+        state.acks = [b != 0 for b in r.raw(n)]
+        r.assert_eof()
+        return state
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, KeygenState)
+            and self.commitment == other.commitment
+            and self.values == other.values
+            and self.acks == other.acks
+        )
+
+
+@dataclass
+class ThresholdKeyring:
+    """Output of a successful keygen (reference: Data/ThresholdKeyring.cs)."""
+
+    tpke_priv: tpke.TpkePrivateKey
+    tpke_pub: tpke.TpkePublicKey
+    tpke_verification_keys: List[tpke.TpkeVerificationKey]
+    ts_share: ts.TsPrivateKeyShare
+    ts_key_set: ts.TsPublicKeySet
+
+    @property
+    def public_key_hash(self) -> bytes:
+        """keccak(tpke_pub || ts_key_set) — the confirmation vote payload
+        (reference: TrustlessKeygen.HandleConfirm keyringHash)."""
+        return keccak256(self.tpke_pub.to_bytes() + self.ts_key_set.to_bytes())
+
+    def public_keys(self, f: int, ecdsa_pub_keys: List[bytes]) -> PublicConsensusKeys:
+        return PublicConsensusKeys(
+            n=self.ts_key_set.n,
+            f=f,
+            tpke_pub=self.tpke_pub,
+            tpke_verification_keys=self.tpke_verification_keys,
+            ts_keys=self.ts_key_set,
+            ecdsa_pub_keys=ecdsa_pub_keys,
+        )
+
+    def private_keys(self, ecdsa_priv: Optional[bytes] = None) -> PrivateConsensusKeys:
+        return PrivateConsensusKeys(
+            tpke_priv=self.tpke_priv,
+            ts_share=self.ts_share,
+            ecdsa_priv=ecdsa_priv,
+        )
+
+
+class TrustlessKeygen:
+    """DKG driver for one node (reference: TrustlessKeygen.cs:36-261).
+
+    Messages are produced/consumed by the caller (KeyGenManager routes them
+    through governance transactions); this class is pure protocol state.
+    """
+
+    def __init__(
+        self,
+        ecdsa_priv: bytes,
+        ecdsa_pub_keys: Sequence[bytes],
+        f: int,
+        cycle: int,
+        rng=secrets,
+    ):
+        self._priv = ecdsa_priv
+        self.ecdsa_pub_keys = list(ecdsa_pub_keys)
+        self.n = len(self.ecdsa_pub_keys)
+        self.f = f
+        self.cycle = cycle
+        self._rng = rng
+        my_pub = ecdsa.public_key_bytes(ecdsa_priv)
+        self.my_idx = (
+            self.ecdsa_pub_keys.index(my_pub)
+            if my_pub in self.ecdsa_pub_keys
+            else -1
+        )
+        self.states = [KeygenState(self.n) for _ in range(self.n)]
+        self.finished_dealers: List[int] = []
+        self.confirmations: Dict[bytes, int] = {}
+        self.confirm_sent = False
+
+    # ----- protocol steps -------------------------------------------------
+
+    def start_keygen(self) -> CommitMessage:
+        """Dealer step: sample F(x,y), commit, encrypt rows
+        (reference: TrustlessKeygen.StartKeygen:63-76)."""
+        poly = BiVarSymmetricPolynomial.random(self.f, self._rng)
+        commitment = poly.commit()
+        rows = []
+        for i in range(self.n):
+            row = poly.evaluate_row(i + 1)
+            serialized = b"".join(bls.fr_to_bytes(c) for c in row)
+            rows.append(
+                ecdsa.ecies_encrypt(self.ecdsa_pub_keys[i], serialized)
+            )
+        return CommitMessage(commitment, rows)
+
+    def sender_by_public_key(self, pub: bytes) -> int:
+        try:
+            return self.ecdsa_pub_keys.index(pub)
+        except ValueError:
+            return -1
+
+    def handle_commit(self, sender: int, msg: CommitMessage) -> ValueMessage:
+        """Check my row against the commitment; respond with per-player row
+        evaluations (reference: TrustlessKeygen.HandleCommit:90-109).
+        Raises ValueError on any mismatch (caller treats dealer as faulty)."""
+        if len(msg.encrypted_rows) != self.n:
+            raise ValueError("bad encrypted row count")
+        if msg.commitment.degree != self.f:
+            raise ValueError("commitment degree != f")
+        if self.states[sender].commitment is not None:
+            raise ValueError(f"double commit from sender {sender}")
+        self.states[sender].commitment = msg.commitment
+        committed_row = msg.commitment.evaluate_row(self.my_idx + 1)
+        raw = ecdsa.ecies_decrypt(self._priv, msg.encrypted_rows[self.my_idx])
+        if len(raw) != (self.f + 1) * bls.FR_BYTES:
+            raise ValueError("bad row length")
+        row = [
+            bls.fr_from_bytes(raw[o : o + bls.FR_BYTES])
+            for o in range(0, len(raw), bls.FR_BYTES)
+        ]
+        backend = get_backend()
+        for coeff, committed in zip(row, committed_row):
+            if not bls.g1_eq(backend.g1_mul(bls.G1_GEN, coeff), committed):
+                raise ValueError("commitment does not match row")
+        return ValueMessage(
+            proposer=sender,
+            encrypted_values=[
+                ecdsa.ecies_encrypt(
+                    self.ecdsa_pub_keys[i],
+                    bls.fr_to_bytes(bls.fr_eval_poly(row, i + 1)),
+                )
+                for i in range(self.n)
+            ],
+        )
+
+    def handle_send_value(self, sender: int, msg: ValueMessage) -> bool:
+        """Check F_d(sender+1, me+1) against d's commitment; returns True
+        exactly once, when this node first sees the keygen finished and
+        should broadcast its confirmation
+        (reference: TrustlessKeygen.HandleSendValue:111-135)."""
+        state = self.states[msg.proposer]
+        if state.acks[sender]:
+            raise ValueError("already handled this value")
+        if state.commitment is None:
+            raise ValueError("value before commitment")
+        if len(msg.encrypted_values) != self.n:
+            raise ValueError("bad encrypted value count")
+        value = bls.fr_from_bytes(
+            ecdsa.ecies_decrypt(self._priv, msg.encrypted_values[self.my_idx])
+        )
+        expected = state.commitment.evaluate(self.my_idx + 1, sender + 1)
+        if not bls.g1_eq(get_backend().g1_mul(bls.G1_GEN, value), expected):
+            raise ValueError("decrypted value does not match commitment")
+        # NOTE: unlike the reference (TrustlessKeygen.cs:111-118, which acks
+        # before validating), the ack is recorded only AFTER all checks pass —
+        # otherwise a byzantine sender's garbage value would count toward the
+        # >2f quorum with value 0 and poison the Lagrange interpolation.
+        state.acks[sender] = True
+        state.values[sender] = value
+        if (
+            state.value_count() > 2 * self.f
+            and msg.proposer not in self.finished_dealers
+        ):
+            self.finished_dealers.append(msg.proposer)
+        if self.confirm_sent:
+            return False
+        if not self.finished():
+            return False
+        self.confirm_sent = True
+        return True
+
+    def handle_confirm(self, keyring_hash: bytes) -> bool:
+        """Count confirmation votes per keyring hash; True exactly when the
+        N-f'th matching vote arrives
+        (reference: TrustlessKeygen.HandleConfirm:138-144)."""
+        self.confirmations[keyring_hash] = (
+            self.confirmations.get(keyring_hash, 0) + 1
+        )
+        return self.confirmations[keyring_hash] == self.n - self.f
+
+    def finished(self) -> bool:
+        """> f dealers have > 2f acks (reference: Finished:146-149)."""
+        return (
+            sum(1 for s in self.states if s.value_count() > 2 * self.f)
+            > self.f
+        )
+
+    def try_get_keys(self) -> Optional[ThresholdKeyring]:
+        """Derive the keyring from the first f+1 finished dealers
+        (reference: TryGetKeys:151-181)."""
+        if not self.finished():
+            return None
+        backend = get_backend()
+        # pub-key polynomial = sum of dealers' committed rows at x=0
+        pub_key_poly: List[Optional[tuple]] = [None] * (self.f + 1)
+        secret = 0
+        for dealer in self.finished_dealers[: self.f + 1]:
+            state = self.states[dealer]
+            if state.value_count() <= 2 * self.f:
+                raise RuntimeError("finished dealer without quorum")
+            row_zero = state.commitment.evaluate_row(0)
+            for i, pt in enumerate(row_zero):
+                pub_key_poly[i] = (
+                    pt if pub_key_poly[i] is None
+                    else bls.g1_add(pub_key_poly[i], pt)
+                )
+            secret = (secret + state.interpolate_values()) % bls.R
+        # evaluate g1^{P(i)} for i in 0..n via Horner in the exponent
+        pub_keys = []
+        for i in range(self.n + 1):
+            powers = [pow(i, j, bls.R) for j in range(self.f + 1)]
+            pub_keys.append(backend.g1_msm(pub_key_poly, powers))
+        return ThresholdKeyring(
+            tpke_priv=tpke.TpkePrivateKey(secret, self.my_idx),
+            tpke_pub=tpke.TpkePublicKey(pub_keys[0], t=self.f),
+            tpke_verification_keys=[
+                tpke.TpkeVerificationKey(y) for y in pub_keys[1:]
+            ],
+            ts_share=ts.TsPrivateKeyShare(secret, self.my_idx),
+            ts_key_set=ts.TsPublicKeySet(
+                [ts.TsPublicKey(y) for y in pub_keys[1:]], t=self.f
+            ),
+        )
+
+    # ----- crash-resume serialization ------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """Full-state snapshot, persisted after every step
+        (reference: TrustlessKeygen.ToBytes:195-226)."""
+        out = write_u32(self.n) + write_u32(self.f) + write_u64(self.cycle)
+        for pub in self.ecdsa_pub_keys:
+            out += write_bytes(pub)
+        for state in self.states:
+            out += write_bytes(state.to_bytes())
+        out += write_u32(len(self.finished_dealers))
+        for d in self.finished_dealers:
+            out += write_u32(d)
+        out += write_u32(len(self.confirmations))
+        for h, count in self.confirmations.items():
+            out += write_bytes(h) + write_u32(count)
+        out += bytes([1 if self.confirm_sent else 0])
+        return out
+
+    @classmethod
+    def from_bytes(cls, data: bytes, ecdsa_priv: bytes) -> "TrustlessKeygen":
+        r = Reader(data)
+        n = r.u32()
+        f = r.u32()
+        cycle = r.u64()
+        pub_keys = [r.bytes_() for _ in range(n)]
+        keygen = cls(ecdsa_priv, pub_keys, f, cycle)
+        keygen.states = [
+            KeygenState.from_bytes(r.bytes_()) for _ in range(n)
+        ]
+        keygen.finished_dealers = [r.u32() for _ in range(r.u32())]
+        keygen.confirmations = {
+            r.bytes_(): r.u32() for _ in range(r.u32())
+        }
+        keygen.confirm_sent = r.raw(1)[0] != 0
+        r.assert_eof()
+        return keygen
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, TrustlessKeygen)
+            and self.ecdsa_pub_keys == other.ecdsa_pub_keys
+            and self.my_idx == other.my_idx
+            and self.states == other.states
+            and self.finished_dealers == other.finished_dealers
+            and self.confirmations == other.confirmations
+            and self.confirm_sent == other.confirm_sent
+        )

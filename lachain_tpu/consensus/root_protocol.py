@@ -43,7 +43,7 @@ class RootProtocol(Protocol):
         self._header = None
         self._txs = None
         self._signatures: Dict[int, bytes] = {}
-        self._early_headers: List = []
+        self._early_headers: Dict[int, M.SignedHeaderMessage] = {}
         self._produced = False
 
     # -- era start -------------------------------------------------------------
@@ -102,8 +102,8 @@ class RootProtocol(Protocol):
         )
         self._signatures[self.me] = sig
         # headers that arrived before ours was built
-        early, self._early_headers = self._early_headers, []
-        for sender, msg in early:
+        early, self._early_headers = self._early_headers, {}
+        for sender, msg in early.items():
             self._on_signed_header(sender, msg)
         self._try_produce()
 
@@ -112,8 +112,9 @@ class RootProtocol(Protocol):
         if not isinstance(payload, M.SignedHeaderMessage):
             raise TypeError(f"unexpected payload {type(payload)}")
         if self._header is None:
-            if len(self._early_headers) < 4 * self.n:  # bounded stash
-                self._early_headers.append((sender, payload))
+            # one stashed header per sender: a byzantine flooder can only
+            # displace its own earlier message, never an honest validator's
+            self._early_headers[sender] = payload
             return
         self._on_signed_header(sender, payload)
 

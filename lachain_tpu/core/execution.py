@@ -96,7 +96,10 @@ class TransactionExecuter:
         bal = get_balance(snap, sender)
         if bal < tx.value + fee:
             return receipt(0, sender)
-        # effects
+        # effects; a failed call rolls back everything except the consumed
+        # nonce and fee (reference per-tx snapshot/rollback loop,
+        # BlockManager.cs:371-560)
+        cp = snap.checkpoint()
         set_nonce(snap, sender, tx.nonce + 1)
         set_balance(snap, sender, bal - tx.value - fee)
         if tx.to in self.system_contracts:
@@ -105,7 +108,11 @@ class TransactionExecuter:
                 status, ret = handler(snap, sender, tx, block_index)
             except Exception:
                 status, ret = 0, b""
-            # value moved to the contract address either way
+            if status != 1:
+                snap.restore(cp)
+                set_nonce(snap, sender, tx.nonce + 1)
+                set_balance(snap, sender, bal - fee)
+                return receipt(0, sender, ret)
             set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
             return receipt(status, sender, ret)
         set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)

@@ -263,8 +263,14 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
 
     if sel == SEL_FINISH_LOTTERY:
         # (reference FinishVrfLottery, StakingContract.cs:738-747): close the
-        # phase, pick the next validator set from the winners
+        # phase, pick the next validator set from the winners. Only valid
+        # once per cycle, after the submission phase has ended — otherwise
+        # anyone could reroll the seed mid-phase and grind the election.
         cycle = ctx.block // CYCLE_DURATION
+        if ctx.block % CYCLE_DURATION < VRF_SUBMISSION_PHASE:
+            return 0, b""
+        if ctx.sget(STAKING_ADDRESS, b"lottery_done:" + write_u64(cycle)):
+            return 0, b""
         winners = _get_winner_list(ctx, cycle)
         pubs = []
         for w in winners:
@@ -274,6 +280,7 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
         if pubs:
             from ..utils.serialization import write_bytes_list
 
+            ctx.sput(STAKING_ADDRESS, b"lottery_done:" + write_u64(cycle), b"\x01")
             ctx.sput(
                 STAKING_ADDRESS,
                 b"next_validators",
@@ -405,8 +412,5 @@ def make_executer(chain_id: int) -> execution.TransactionExecuter:
     """TransactionExecuter wired with the system-contract registry."""
     return execution.TransactionExecuter(
         chain_id,
-        system_contracts={
-            addr: lambda snap, sender, tx, block: dispatch(snap, sender, tx, block)
-            for addr in SYSTEM_CONTRACTS
-        },
+        system_contracts=dict(SYSTEM_CONTRACTS),
     )

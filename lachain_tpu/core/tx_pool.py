@@ -73,28 +73,36 @@ class TransactionPool:
             per_sender: Dict[bytes, List[SignedTransaction]] = {}
             for h, stx in self._txs.items():
                 per_sender.setdefault(self._senders[h], []).append(stx)
-            candidates: List[Tuple[int, bytes, SignedTransaction]] = []
+            # per-sender executable chains, nonce-ascending
+            chains: Dict[bytes, List[SignedTransaction]] = {}
             for sender, txs in per_sender.items():
                 txs.sort(key=lambda t: t.tx.nonce)
                 nonce = self._account_nonce(sender)
+                chain = []
                 for t in txs:
                     if t.tx.nonce != nonce:
                         break  # gap: later nonces are unexecutable
-                    candidates.append((t.tx.gas_price, t.hash(), t))
+                    chain.append(t)
                     nonce += 1
-            candidates.sort(key=lambda c: (-c[0], c[1]))
+                if chain:
+                    chains[sender] = chain
+            # repeatedly take the highest-fee among the next-executable txs,
+            # so a cheap prerequisite nonce never strands an expensive later
+            # one (chain heads advance as they are picked)
             picked: List[SignedTransaction] = []
-            taken_count: Dict[bytes, int] = {}
-            for _, _, t in candidates:
-                if len(picked) >= max_txs:
-                    break
-                sender = self._senders[t.hash()]
-                # keep nonce continuity within the proposal
-                expect = self._account_nonce(sender) + taken_count.get(sender, 0)
-                if t.tx.nonce != expect:
-                    continue
-                picked.append(t)
-                taken_count[sender] = taken_count.get(sender, 0) + 1
+            heads: Dict[bytes, int] = {s: 0 for s in chains}
+            while len(picked) < max_txs and heads:
+                best_sender = max(
+                    heads,
+                    key=lambda s: (
+                        chains[s][heads[s]].tx.gas_price,
+                        chains[s][heads[s]].hash(),
+                    ),
+                )
+                picked.append(chains[best_sender][heads[best_sender]])
+                heads[best_sender] += 1
+                if heads[best_sender] >= len(chains[best_sender]):
+                    del heads[best_sender]
             return picked
 
     # -- lifecycle --------------------------------------------------------------
@@ -127,6 +135,10 @@ class TransactionPool:
                 continue
             if self.add(stx):
                 count += 1
+            else:
+                # rejected on re-admission (stale nonce, fee floor, ...):
+                # drop the persisted entry or it is re-read every restart
+                self._kv.delete(key)
         return count
 
     def _evict(self, h: bytes) -> None:

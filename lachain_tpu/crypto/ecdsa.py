@@ -168,6 +168,53 @@ def verify_hash(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
     return pt[0] % N == r
 
 
+def ecdh_shared_secret(priv: bytes, pub: bytes) -> bytes:
+    """32-byte shared secret: sha256 of the compressed shared point
+    (role of the reference's EcdhAgreement inside Secp256K1Encrypt,
+    DefaultCrypto.cs:301-318)."""
+    pt = _mul(decompress_public_key(pub), int.from_bytes(priv, "big"))
+    if pt is None:
+        raise ValueError("degenerate ECDH result")
+    compressed = bytes([0x02 | (pt[1] & 1)]) + pt[0].to_bytes(32, "big")
+    return hashlib.sha256(compressed).digest()
+
+
+def aes_gcm_encrypt(key: bytes, plaintext: bytes) -> bytes:
+    """nonce(12) || ciphertext+tag (reference: DefaultCrypto.AesGcmEncrypt,
+    DefaultCrypto.cs:267-283)."""
+    import secrets as _secrets
+
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    nonce = _secrets.token_bytes(12)
+    return nonce + AESGCM(key).encrypt(nonce, plaintext, None)
+
+
+def aes_gcm_decrypt(key: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    if len(data) < 12 + 16:
+        raise ValueError("AES-GCM payload too short")
+    return AESGCM(key).decrypt(data[:12], data[12:], None)
+
+
+def ecies_encrypt(pub: bytes, plaintext: bytes, rng=None) -> bytes:
+    """ECIES = ephemeral ECDH + AES-GCM
+    (reference: DefaultCrypto.Secp256K1Encrypt, DefaultCrypto.cs:301-318).
+    Layout: ephemeral compressed pubkey (33) || nonce (12) || ct+tag."""
+    eph = generate_private_key(rng)
+    key = ecdh_shared_secret(eph, pub)
+    return public_key_bytes(eph) + aes_gcm_encrypt(key, plaintext)
+
+
+def ecies_decrypt(priv: bytes, data: bytes) -> bytes:
+    """(reference: DefaultCrypto.Secp256K1Decrypt, DefaultCrypto.cs:320-336)"""
+    if len(data) < 33 + 12 + 16:
+        raise ValueError("ECIES payload too short")
+    key = ecdh_shared_secret(priv, data[:33])
+    return aes_gcm_decrypt(key, data[33:])
+
+
 def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     """Recover the compressed public key from a 65-byte signature."""
     if len(sig) != 65:

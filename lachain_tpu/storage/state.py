@@ -109,6 +109,16 @@ class Snapshot:
         for name in SUBTREES:
             self._writes[name].clear()
 
+    def checkpoint(self) -> Dict[str, Dict[bytes, Optional[bytes]]]:
+        """Capture the write buffer for per-tx rollback (role of the
+        reference's per-tx snapshot/approve/rollback loop,
+        BlockManager.cs:371-560)."""
+        return {name: dict(self._writes[name]) for name in SUBTREES}
+
+    def restore(self, cp: Dict[str, Dict[bytes, Optional[bytes]]]) -> None:
+        """Rewind the write buffer to a checkpoint."""
+        self._writes = {name: dict(cp[name]) for name in SUBTREES}
+
 
 class StateManager:
     """Committed-chain state keeper

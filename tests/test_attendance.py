@@ -1,0 +1,34 @@
+"""ValidatorAttendance window rotation + serialization tests
+(reference behavior: ValidatorAttendance.cs:82-119)."""
+from lachain_tpu.consensus.attendance import ValidatorAttendance
+
+
+def test_increment_and_get():
+    a = ValidatorAttendance(previous_cycle=5)
+    pk = b"\x01" * 33
+    a.increment(pk, 5)
+    a.increment(pk, 5)
+    a.increment(pk, 6)
+    a.increment(pk, 7)  # outside window: ignored
+    assert a.get(pk, 5) == 2
+    assert a.get(pk, 6) == 1
+    assert a.get(pk, 7) == 0
+    assert a.get(b"\x02" * 33, 5) == 0
+
+
+def test_serialization_window_rotation():
+    a = ValidatorAttendance(5)
+    pk1, pk2 = b"\x01" * 33, b"\x02" * 33
+    a.increment(pk1, 5)
+    a.increment(pk2, 6)
+    raw = a.to_bytes()
+    # same cycle: identity
+    same = ValidatorAttendance.from_bytes(raw, 5, current_as_next=False)
+    assert same == a and same.get(pk2, 6) == 1
+    # next cycle, current-as-next: window slides, next becomes previous
+    slid = ValidatorAttendance.from_bytes(raw, 6, current_as_next=True)
+    assert slid.previous_cycle == 6 and slid.get(pk2, 6) == 1
+    assert slid.get(pk1, 5) == 0
+    # two cycles ahead: stale data dropped
+    fresh = ValidatorAttendance.from_bytes(raw, 8, current_as_next=False)
+    assert fresh.get(pk1, 8) == 0 and fresh.previous_cycle == 8

@@ -1,0 +1,160 @@
+"""Trustless DKG tests.
+
+Mirrors /root/reference/test/Lachain.ConsensusTest/TrustlessKeygenTest.cs:
+full commit/value/confirm exchange at (N,F) sweeps, derived-key consistency
+(all nodes compute the same public keyring; shares sign/decrypt under it),
+crash-resume serialization, and faulty-dealer rejection.
+"""
+import random
+
+import pytest
+
+from lachain_tpu.consensus import keygen as kg
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.crypto import threshold_sig as ts
+
+
+class SeededRng:
+    def __init__(self, seed):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def make_nodes(n, f, seed=42):
+    rng = SeededRng(seed)
+    privs = [ecdsa.generate_private_key(rng) for _ in range(n)]
+    pubs = [ecdsa.public_key_bytes(p) for p in privs]
+    nodes = [
+        kg.TrustlessKeygen(privs[i], pubs, f, cycle=0, rng=SeededRng(seed + i))
+        for i in range(n)
+    ]
+    return privs, pubs, nodes
+
+
+def run_full_keygen(nodes):
+    """Deliver every commit then every value to every node, in the same
+    total order everywhere (the on-chain-transaction delivery model)."""
+    n = len(nodes)
+    commits = [(d, node.start_keygen()) for d, node in enumerate(nodes)]
+    confirm_ready = [False] * n
+    # commits are processed in order; each handle_commit yields a ValueMessage
+    # from that receiver, which is then also delivered in order to everyone.
+    for dealer, commit in commits:
+        values = []
+        for i, node in enumerate(nodes):
+            values.append((i, node.handle_commit(dealer, commit)))
+        for sender, vmsg in values:
+            for i, node in enumerate(nodes):
+                if node.handle_send_value(sender, vmsg):
+                    confirm_ready[i] = True
+    assert all(node.finished() for node in nodes)
+    keyrings = [node.try_get_keys() for node in nodes]
+    assert all(k is not None for k in keyrings)
+    return keyrings, confirm_ready
+
+
+@pytest.mark.parametrize("n,f", [(4, 1), (7, 2)])
+def test_keygen_derives_consistent_keys(n, f):
+    _, _, nodes = make_nodes(n, f)
+    keyrings, confirm_ready = run_full_keygen(nodes)
+    assert all(confirm_ready)
+    # identical public keyring everywhere
+    hashes = {k.public_key_hash for k in keyrings}
+    assert len(hashes) == 1
+    # confirmation quorum fires exactly at N-F votes
+    fired = []
+    for node in nodes:
+        for k in keyrings:
+            if node.handle_confirm(k.public_key_hash):
+                fired.append(node.my_idx)
+                break  # one vote per keyring hash per sender in this model
+    # threshold-signature shares from the DKG combine under the derived keys
+    msg = b"post-dkg coin"
+    shares = [k.ts_share.sign(msg) for k in keyrings]
+    key_set = keyrings[0].ts_key_set
+    for s in shares:
+        assert key_set.verify_share(msg, s)
+    sig = key_set.combine(shares[: f + 1])
+    assert key_set.shared.verify(msg, sig)
+    # ... and any f+1 subset combines to the same signature
+    sig2 = key_set.combine(shares[-(f + 1):])
+    assert sig.to_bytes() == sig2.to_bytes()
+
+
+def test_keygen_tpke_roundtrip():
+    n, f = 4, 1
+    _, _, nodes = make_nodes(n, f, seed=7)
+    keyrings, _ = run_full_keygen(nodes)
+    pub = keyrings[0].tpke_pub
+    msg = b"x" * 32
+    share = pub.encrypt(msg, share_id=3)
+    partials = [k.tpke_priv.decrypt_share(share) for k in keyrings[: f + 1]]
+    for p in partials:
+        vk = keyrings[0].tpke_verification_keys[p.decryptor_id]
+        assert pub.verify_share(vk, p, share)
+    assert pub.full_decrypt(share, partials) == msg
+
+
+def test_keygen_crash_resume_serialization():
+    n, f = 4, 1
+    privs, pubs, nodes = make_nodes(n, f, seed=9)
+    commits = [(d, node.start_keygen()) for d, node in enumerate(nodes)]
+    # process only the first two commits, then snapshot node 0 mid-protocol
+    for dealer, commit in commits[:2]:
+        values = [(i, node.handle_commit(dealer, commit)) for i, node in enumerate(nodes)]
+        for sender, vmsg in values:
+            for node in nodes:
+                node.handle_send_value(sender, vmsg)
+    snapshot = nodes[0].to_bytes()
+    resumed = kg.TrustlessKeygen.from_bytes(snapshot, privs[0])
+    assert resumed == nodes[0]
+    # the resumed node completes the protocol alongside the originals
+    nodes[0] = resumed
+    for dealer, commit in commits[2:]:
+        values = [(i, node.handle_commit(dealer, commit)) for i, node in enumerate(nodes)]
+        for sender, vmsg in values:
+            for node in nodes:
+                node.handle_send_value(sender, vmsg)
+    keyrings = [node.try_get_keys() for node in nodes]
+    assert len({k.public_key_hash for k in keyrings}) == 1
+
+
+def test_keygen_rejects_bad_row():
+    n, f = 4, 1
+    privs, pubs, nodes = make_nodes(n, f, seed=11)
+    commit = nodes[1].start_keygen()
+    # corrupt the encrypted row addressed to node 0
+    bad_rows = list(commit.encrypted_rows)
+    bad_rows[0] = ecdsa.ecies_encrypt(pubs[0], b"\x00" * ((f + 1) * bls.FR_BYTES))
+    bad = kg.CommitMessage(commit.commitment, bad_rows)
+    with pytest.raises(ValueError):
+        nodes[0].handle_commit(1, bad)
+    # an honest receiver still accepts the original
+    nodes[2].handle_commit(1, commit)
+
+
+def test_keygen_rejects_double_commit_and_replayed_value():
+    n, f = 4, 1
+    _, _, nodes = make_nodes(n, f, seed=13)
+    commit = nodes[1].start_keygen()
+    vmsg = nodes[0].handle_commit(1, commit)
+    with pytest.raises(ValueError):
+        nodes[0].handle_commit(1, commit)  # double commit
+    nodes[0].handle_send_value(0, vmsg)
+    with pytest.raises(ValueError):
+        nodes[0].handle_send_value(0, vmsg)  # replayed value
+
+
+def test_ecies_roundtrip():
+    rng = SeededRng(3)
+    priv = ecdsa.generate_private_key(rng)
+    pub = ecdsa.public_key_bytes(priv)
+    for size in (0, 1, 32, 1000):
+        ct = ecdsa.ecies_encrypt(pub, b"a" * size)
+        assert ecdsa.ecies_decrypt(priv, ct) == b"a" * size
+    other = ecdsa.generate_private_key(rng)
+    with pytest.raises(Exception):
+        ecdsa.ecies_decrypt(other, ecdsa.ecies_encrypt(pub, b"secret"))
