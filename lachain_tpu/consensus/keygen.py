@@ -207,18 +207,26 @@ class KeygenState:
     def __init__(self, n: int):
         self.commitment: Optional[Commitment] = None
         self.values: List[int] = [0] * n
+        # acks follow the shared on-chain message order (deterministic across
+        # nodes); valid[] is this node's local check that the decrypted value
+        # matched the commitment — only valid values enter interpolation
         self.acks: List[bool] = [False] * n
+        self.valid: List[bool] = [False] * n
 
     def value_count(self) -> int:
         return sum(self.acks)
 
     def interpolate_values(self) -> int:
-        """F_d(0, my_idx+1): Lagrange-interpolate the first degree+1 acked
-        sender values at 0 (reference: State.InterpolateValues)."""
+        """F_d(0, my_idx+1): Lagrange-interpolate the first degree+1 VALID
+        sender values at 0 (reference: State.InterpolateValues). Any
+        degree+1 commitment-checked points of the degree-f row polynomial
+        interpolate to the same share, so node-local validity cannot skew
+        the result; with > 2f acks at least f+1 are from honest senders and
+        decrypt validly."""
         if self.commitment is None:
             raise ValueError("cannot interpolate without commitment")
         need = self.commitment.degree + 1
-        xs = [i + 1 for i, a in enumerate(self.acks) if a][:need]
+        xs = [i + 1 for i, v in enumerate(self.valid) if v][:need]
         ys = [self.values[x - 1] for x in xs]
         if len(xs) != need:
             raise ValueError("not enough values to interpolate")
@@ -230,6 +238,7 @@ class KeygenState:
         out += write_u32(len(self.acks))
         out += b"".join(bls.fr_to_bytes(v) for v in self.values)
         out += bytes(1 if a else 0 for a in self.acks)
+        out += bytes(1 if v else 0 for v in self.valid)
         return out
 
     @classmethod
@@ -244,6 +253,7 @@ class KeygenState:
             bls.fr_from_bytes(r.raw(bls.FR_BYTES)) for _ in range(n)
         ]
         state.acks = [b != 0 for b in r.raw(n)]
+        state.valid = [b != 0 for b in r.raw(n)]
         r.assert_eof()
         return state
 
@@ -253,6 +263,7 @@ class KeygenState:
             and self.commitment == other.commitment
             and self.values == other.values
             and self.acks == other.acks
+            and self.valid == other.valid
         )
 
 
@@ -348,6 +359,10 @@ class TrustlessKeygen:
         """Check my row against the commitment; respond with per-player row
         evaluations (reference: TrustlessKeygen.HandleCommit:90-109).
         Raises ValueError on any mismatch (caller treats dealer as faulty)."""
+        if not 0 <= sender < self.n:
+            raise ValueError(f"commit from unknown sender {sender}")
+        if self.my_idx < 0:
+            raise ValueError("this node is not a keygen participant")
         if len(msg.encrypted_rows) != self.n:
             raise ValueError("bad encrypted row count")
         if msg.commitment.degree != self.f:
@@ -356,7 +371,12 @@ class TrustlessKeygen:
             raise ValueError(f"double commit from sender {sender}")
         self.states[sender].commitment = msg.commitment
         committed_row = msg.commitment.evaluate_row(self.my_idx + 1)
-        raw = ecdsa.ecies_decrypt(self._priv, msg.encrypted_rows[self.my_idx])
+        try:
+            raw = ecdsa.ecies_decrypt(
+                self._priv, msg.encrypted_rows[self.my_idx]
+            )
+        except Exception as e:
+            raise ValueError(f"undecryptable row: {e}") from e
         if len(raw) != (self.f + 1) * bls.FR_BYTES:
             raise ValueError("bad row length")
         row = [
@@ -383,6 +403,12 @@ class TrustlessKeygen:
         exactly once, when this node first sees the keygen finished and
         should broadcast its confirmation
         (reference: TrustlessKeygen.HandleSendValue:111-135)."""
+        if not 0 <= msg.proposer < self.n:
+            raise ValueError(f"value for unknown dealer {msg.proposer}")
+        if not 0 <= sender < self.n:
+            raise ValueError(f"value from unknown sender {sender}")
+        if self.my_idx < 0:
+            raise ValueError("this node is not a keygen participant")
         state = self.states[msg.proposer]
         if state.acks[sender]:
             raise ValueError("already handled this value")
@@ -390,18 +416,27 @@ class TrustlessKeygen:
             raise ValueError("value before commitment")
         if len(msg.encrypted_values) != self.n:
             raise ValueError("bad encrypted value count")
-        value = bls.fr_from_bytes(
-            ecdsa.ecies_decrypt(self._priv, msg.encrypted_values[self.my_idx])
-        )
-        expected = state.commitment.evaluate(self.my_idx + 1, sender + 1)
-        if not bls.g1_eq(get_backend().g1_mul(bls.G1_GEN, value), expected):
-            raise ValueError("decrypted value does not match commitment")
-        # NOTE: unlike the reference (TrustlessKeygen.cs:111-118, which acks
-        # before validating), the ack is recorded only AFTER all checks pass —
-        # otherwise a byzantine sender's garbage value would count toward the
-        # >2f quorum with value 0 and poison the Lagrange interpolation.
+        # the ack is recorded on receipt, after the structural checks every
+        # node evaluates identically on the shared on-chain order — so the
+        # > 2f quorum (and finished_dealers membership) is deterministic
+        # across nodes (reference TrustlessKeygen.cs:111-118 acks the same
+        # way). Whether MY ciphertext decrypted to a commitment-consistent
+        # value is node-local and only gates interpolation (valid[]), so a
+        # byzantine sender can neither poison the Lagrange sum nor split the
+        # quorum.
         state.acks[sender] = True
-        state.values[sender] = value
+        try:
+            value = bls.fr_from_bytes(
+                ecdsa.ecies_decrypt(
+                    self._priv, msg.encrypted_values[self.my_idx]
+                )
+            )
+            expected = state.commitment.evaluate(self.my_idx + 1, sender + 1)
+            if bls.g1_eq(get_backend().g1_mul(bls.G1_GEN, value), expected):
+                state.valid[sender] = True
+                state.values[sender] = value
+        except Exception:
+            pass  # structurally fine but undecryptable for me: ack w/o valid
         if (
             state.value_count() > 2 * self.f
             and msg.proposer not in self.finished_dealers

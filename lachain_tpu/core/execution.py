@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..crypto.hashes import keccak256
 from ..storage.state import Snapshot
-from ..utils.serialization import Reader, write_u64, write_u256
+from ..utils.serialization import Reader, write_u32, write_u64, write_u256
 from .types import (
     ADDRESS_BYTES,
     SignedTransaction,
@@ -73,12 +73,14 @@ class TransactionExecuter:
     ) -> ExecutionResult:
         tx_hash = stx.hash()
 
-        def receipt(status: int, sender: bytes, ret: bytes = b"") -> ExecutionResult:
+        def receipt(
+            status: int, sender: bytes, ret: bytes = b"", gas: int = GAS_PER_TX
+        ) -> ExecutionResult:
             r = TransactionReceipt(
                 tx_hash=tx_hash,
                 block_index=block_index,
                 index_in_block=index_in_block,
-                gas_used=GAS_PER_TX,
+                gas_used=gas,
                 status=status,
                 sender=sender,
                 return_data=ret,
@@ -115,5 +117,47 @@ class TransactionExecuter:
                 return receipt(0, sender, ret)
             set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
             return receipt(status, sender, ret)
+        # deployed WASM contract call (reference TransactionExecuter.cs ->
+        # ContractInvoker.Invoke -> VirtualMachine.InvokeWasmContract)
+        from ..vm import vm as wasm_vm
+
+        if tx.invocation and wasm_vm.get_code(snap, tx.to) is not None:
+            # the full gas limit must be payable up front: metered work is
+            # charged even when the call reverts (reference gas accounting —
+            # BlockManager._Execute collects gas on failed receipts too)
+            if bal < tx.value + tx.gas_limit * tx.gas_price:
+                snap.restore(cp)
+                set_nonce(snap, sender, tx.nonce + 1)
+                set_balance(snap, sender, bal - fee)
+                return receipt(0, sender)
+            set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
+            machine = wasm_vm.VirtualMachine(
+                snap,
+                block_index=block_index,
+                origin=sender,
+                gas_price=tx.gas_price,
+                chain_id=self.chain_id,
+            )
+            res = machine.invoke_contract(
+                contract=tx.to,
+                sender=sender,
+                value=tx.value,
+                input=tx.invocation,
+                gas_limit=max(0, tx.gas_limit - GAS_PER_TX),
+            )
+            gas_total = GAS_PER_TX + res.gas_used
+            if res.status != 1:
+                snap.restore(cp)
+                set_nonce(snap, sender, tx.nonce + 1)
+                set_balance(snap, sender, bal - gas_total * tx.gas_price)
+                return receipt(0, sender, res.return_data, gas=gas_total)
+            set_balance(
+                snap,
+                sender,
+                get_balance(snap, sender) - res.gas_used * tx.gas_price,
+            )
+            for i, (contract, data) in enumerate(res.events):
+                snap.put("events", tx_hash + write_u32(i), contract + data)
+            return receipt(1, sender, res.return_data, gas=gas_total)
         set_balance(snap, tx.to, get_balance(snap, tx.to) + tx.value)
         return receipt(1, sender)

@@ -99,13 +99,14 @@ class SystemContractContext:
 
 
 def deploy_contract(ctx: SystemContractContext, args: Reader) -> Tuple[int, bytes]:
+    from ..vm.vm import deploy_code
+
     code = args.bytes_()
     if not code or len(code) > 512 * 1024:
         return 0, b""
-    addr = keccak256(ctx.sender + write_u64(ctx.tx.nonce))[12:]
-    if ctx.snap.get("contracts", addr) is not None:
+    status, addr = deploy_code(ctx.snap, ctx.sender, ctx.tx.nonce, code)
+    if status != 1:
         return 0, b""
-    ctx.snap.put("contracts", addr, code)
     ctx.emit(DEPLOY_ADDRESS, b"deployed" + addr)
     return 1, addr
 

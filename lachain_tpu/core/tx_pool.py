@@ -10,6 +10,7 @@ Parity with the reference's TransactionPool
 """
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -88,21 +89,20 @@ class TransactionPool:
                     chains[sender] = chain
             # repeatedly take the highest-fee among the next-executable txs,
             # so a cheap prerequisite nonce never strands an expensive later
-            # one (chain heads advance as they are picked)
+            # one (chain heads advance as they are picked). Heap keys are
+            # precomputed — one hash per tx, not per comparison.
+            def heap_key(stx: SignedTransaction):
+                h = stx.hash()
+                return (-stx.tx.gas_price, bytes(255 - b for b in h))
+
             picked: List[SignedTransaction] = []
-            heads: Dict[bytes, int] = {s: 0 for s in chains}
-            while len(picked) < max_txs and heads:
-                best_sender = max(
-                    heads,
-                    key=lambda s: (
-                        chains[s][heads[s]].tx.gas_price,
-                        chains[s][heads[s]].hash(),
-                    ),
-                )
-                picked.append(chains[best_sender][heads[best_sender]])
-                heads[best_sender] += 1
-                if heads[best_sender] >= len(chains[best_sender]):
-                    del heads[best_sender]
+            heap = [(heap_key(chain[0]), s, 0) for s, chain in chains.items()]
+            heapq.heapify(heap)
+            while len(picked) < max_txs and heap:
+                _, s, i = heapq.heappop(heap)
+                picked.append(chains[s][i])
+                if i + 1 < len(chains[s]):
+                    heapq.heappush(heap, (heap_key(chains[s][i + 1]), s, i + 1))
             return picked
 
     # -- lifecycle --------------------------------------------------------------

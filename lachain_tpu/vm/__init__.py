@@ -1,0 +1,25 @@
+"""Gas-metered WASM contract VM.
+
+Parity with the reference's VM layer
+(/root/reference/src/Lachain.Core/Blockchain/VM/: VirtualMachine.cs,
+ExternalHandler.cs, GasMetering.cs, ContractEncoder.cs, ContractDecoder.cs,
+ExecutionFrame/) — but self-contained: the reference embeds the
+dotnet-webassembly engine (a git submodule); here the engine is our own
+MVP-spec interpreter, so the framework carries no external WASM dependency.
+"""
+from .wasm import Module, WasmDecodeError, decode_module
+from .interpreter import Instance, WasmTrap, OutOfGas, GasMeter
+from .vm import VirtualMachine, HaltException, InvocationResult
+
+__all__ = [
+    "Module",
+    "WasmDecodeError",
+    "decode_module",
+    "Instance",
+    "WasmTrap",
+    "OutOfGas",
+    "GasMeter",
+    "VirtualMachine",
+    "HaltException",
+    "InvocationResult",
+]

@@ -1,0 +1,192 @@
+"""VirtualMachine facade: contract invocation with frame stack.
+
+Parity with the reference's VM driver
+(/root/reference/src/Lachain.Core/Blockchain/VM/VirtualMachine.cs:17-113:
+InvokeWasmContract/ExecuteFrame + frame stack; ExecutionFrame/*.cs). The
+contract entrypoint is the exported `start` function
+(WasmExecutionFrame.cs:84); calldata and results flow through the `env`
+host-import table (external.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..crypto.hashes import keccak256
+from ..storage.state import Snapshot
+from . import gas as G
+from .external import build_env
+from .interpreter import GasMeter, Instance, OutOfGas, WasmTrap
+from .wasm import WasmDecodeError, decode_module
+
+MAX_FRAME_DEPTH = 16
+
+CODE_PREFIX = b"c:"  # 'contracts' subtree: code by address
+
+
+class HaltException(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"halt({code})")
+        self.code = code
+
+
+@dataclass
+class InvocationResult:
+    status: int  # 1 ok, 0 failed
+    gas_used: int
+    return_data: bytes = b""
+    events: List[Tuple[bytes, bytes]] = field(default_factory=list)
+
+
+def get_code(snap: Snapshot, address: bytes) -> Optional[bytes]:
+    return snap.get("contracts", CODE_PREFIX + address)
+
+
+def set_code(snap: Snapshot, address: bytes, code: bytes) -> None:
+    snap.put("contracts", CODE_PREFIX + address, code)
+
+
+def contract_address(sender: bytes, nonce: int) -> bytes:
+    """Deterministic deploy address (reference DeployContract.cs builds it
+    from sender+nonce)."""
+    return keccak256(sender + nonce.to_bytes(8, "big"))[12:]
+
+
+def create2_address(sender: bytes, salt: bytes, code: bytes) -> bytes:
+    return keccak256(b"\xff" + sender + salt + keccak256(code))[12:]
+
+
+class ExecutionFrame:
+    """One contract activation (reference ExecutionFrame/WasmExecutionFrame.cs)."""
+
+    def __init__(
+        self,
+        *,
+        contract: bytes,
+        storage_owner: bytes,
+        sender: bytes,
+        value: int,
+        input: bytes,
+        static: bool,
+    ):
+        self.contract = contract
+        self.storage_owner = storage_owner  # differs under delegatecall
+        self.sender = sender
+        self.value = value
+        self.input = input
+        self.static = static
+        self.return_data = b""
+        self.child_return = b""
+        self.halted = False
+        self.instance: Optional[Instance] = None
+
+
+class VirtualMachine:
+    """Per-invocation VM context: snapshot, tx metadata, frame stack, meter."""
+
+    def __init__(
+        self,
+        snap: Snapshot,
+        *,
+        block_index: int,
+        origin: bytes,
+        gas_price: int,
+        chain_id: int,
+        block_gas_limit: int = G.DEFAULT_BLOCK_GAS_LIMIT,
+    ):
+        self.snap = snap
+        self.block_index = block_index
+        self.origin = origin
+        self.gas_price = gas_price
+        self.chain_id = chain_id
+        self.block_gas_limit = block_gas_limit
+        self.frames: List[ExecutionFrame] = []
+        self.events: List[Tuple[bytes, bytes]] = []
+        self.gas: Optional[GasMeter] = None
+
+    @property
+    def frame(self) -> ExecutionFrame:
+        return self.frames[-1]
+
+    def invoke_contract(
+        self,
+        *,
+        contract: bytes,
+        sender: bytes,
+        value: int,
+        input: bytes,
+        gas_limit: int,
+        static: bool = False,
+        code: Optional[bytes] = None,
+        storage_owner: Optional[bytes] = None,
+    ) -> InvocationResult:
+        """Run the `start` export of the contract at `contract`."""
+        if len(self.frames) >= MAX_FRAME_DEPTH:
+            return InvocationResult(status=0, gas_used=0, return_data=b"")
+        code = code if code is not None else get_code(self.snap, contract)
+        if code is None:
+            return InvocationResult(status=0, gas_used=0)
+        top_level = not self.frames
+        if top_level:
+            self.gas = GasMeter(min(gas_limit, self.block_gas_limit))
+            self.events = []
+        meter = self.gas
+        assert meter is not None
+        frame = ExecutionFrame(
+            contract=contract,
+            storage_owner=storage_owner or contract,
+            sender=sender,
+            value=value,
+            input=input,
+            static=static or (self.frames[-1].static if self.frames else False),
+        )
+        self.frames.append(frame)
+        cp = self.snap.checkpoint()
+        n_events = len(self.events)
+        start_gas = meter.spent
+        try:
+            meter.charge(len(input) * G.INPUT_DATA_GAS_PER_BYTE)
+            module = decode_module(code)
+            frame.instance = Instance(module, host=build_env(self, frame), gas=meter)
+            frame.instance.invoke("start", [])
+            status = 1
+        except HaltException as e:
+            status = 1 if e.code == 0 else 0
+        except OutOfGas:
+            status = 0
+        except (WasmTrap, WasmDecodeError, RecursionError):
+            status = 0
+        finally:
+            self.frames.pop()
+        gas_used = meter.spent - start_gas
+        if status != 1:
+            self.snap.restore(cp)
+            del self.events[n_events:]
+            return InvocationResult(status=0, gas_used=gas_used)
+        result = InvocationResult(
+            status=1, gas_used=gas_used, return_data=frame.return_data
+        )
+        if top_level:
+            result.events = list(self.events)
+        return result
+
+
+def deploy_code(
+    snap: Snapshot, sender: bytes, nonce: int, code: bytes
+) -> Tuple[int, bytes]:
+    """Validate + store contract code; returns (status, address).
+
+    Parity: DeployContract.cs:1-213 — the code must be a decodable WASM
+    module exporting `start`."""
+    try:
+        module = decode_module(code)
+    except WasmDecodeError:
+        return 0, b""
+    exp = module.export_map().get("start")
+    if exp is None or exp.kind != 0:
+        return 0, b""
+    addr = contract_address(sender, nonce)
+    if get_code(snap, addr) is not None:
+        return 0, b""
+    set_code(snap, addr, code)
+    return 1, addr

@@ -1,0 +1,488 @@
+"""WASM VM tests: decoder, interpreter semantics, gas, host env, contracts.
+
+Mirrors the reference's VM suites
+(test/Lachain.CoreTest/IntegrationTests/VirtualMachineTest.cs,
+ContractTests.cs) — but fixtures are assembled in-process with
+lachain_tpu.vm.builder instead of checked-in .wasm blobs.
+"""
+import pytest
+
+from lachain_tpu.core import execution, system_contracts
+from lachain_tpu.core.types import Transaction, sign_transaction
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.storage.kv import MemoryKV
+from lachain_tpu.storage.state import StateManager
+from lachain_tpu.utils.serialization import write_bytes
+from lachain_tpu.vm import abi
+from lachain_tpu.vm.builder import I32, I64, ModuleBuilder, Op
+from lachain_tpu.vm.interpreter import GasMeter, Instance, OutOfGas, WasmTrap
+from lachain_tpu.vm.vm import VirtualMachine, deploy_code, get_code
+from lachain_tpu.vm.wasm import decode_module
+
+CHAIN = 97
+
+
+def instantiate(b: ModuleBuilder, host=None, gas=None) -> Instance:
+    return Instance(decode_module(b.build()), host=host, gas=gas)
+
+
+# ---------------------------------------------------------------------------
+# interpreter semantics
+# ---------------------------------------------------------------------------
+
+
+def test_add_function():
+    b = ModuleBuilder()
+    b.add_function(
+        [I32, I32], [I32], [],
+        [Op.local_get(0), Op.local_get(1), Op.i32_add],
+        export="add",
+    )
+    inst = instantiate(b)
+    assert inst.invoke("add", [2, 3]) == 5
+    # i32 wrap-around
+    assert inst.invoke("add", [0xFFFFFFFF, 1]) == 0
+
+
+def test_loop_sum_and_branches():
+    # sum 1..n with a loop; also exercises br_if, locals
+    b = ModuleBuilder()
+    body = [
+        Op.block(),  # depth 1
+        Op.loop(),  # depth 2
+        Op.local_get(0), Op.i32_eqz, Op.br_if(1),  # exit when n == 0
+        Op.local_get(1), Op.local_get(0), Op.i32_add, Op.local_set(1),
+        Op.local_get(0), Op.i32_const(1), Op.i32_sub, Op.local_set(0),
+        Op.br(0),
+        Op.end,
+        Op.end,
+        Op.local_get(1),
+    ]
+    b.add_function([I32], [I32], [I32], body, export="sum")
+    inst = instantiate(b)
+    assert inst.invoke("sum", [10]) == 55
+    assert inst.invoke("sum", [0]) == 0
+    assert inst.invoke("sum", [1000]) == 500500
+
+
+def test_if_else_and_select():
+    b = ModuleBuilder()
+    b.add_function(
+        [I32], [I32], [],
+        [
+            Op.local_get(0),
+            Op.if_(I32),
+            Op.i32_const(111),
+            Op.else_,
+            Op.i32_const(222),
+            Op.end,
+        ],
+        export="pick",
+    )
+    b.add_function(
+        [I32], [I32], [],
+        [Op.i32_const(7), Op.i32_const(9), Op.local_get(0), Op.select],
+        export="sel",
+    )
+    inst = instantiate(b)
+    assert inst.invoke("pick", [1]) == 111
+    assert inst.invoke("pick", [0]) == 222
+    assert inst.invoke("sel", [1]) == 7
+    assert inst.invoke("sel", [0]) == 9
+
+
+def test_br_table():
+    b = ModuleBuilder()
+    body = [
+        Op.block(), Op.block(), Op.block(),
+        Op.local_get(0),
+        Op.br_table([0, 1], 2),
+        Op.end,
+        Op.i32_const(100), Op.return_,
+        Op.end,
+        Op.i32_const(200), Op.return_,
+        Op.end,
+        Op.i32_const(300),
+    ]
+    b.add_function([I32], [I32], [], body, export="route")
+    inst = instantiate(b)
+    assert inst.invoke("route", [0]) == 100
+    assert inst.invoke("route", [1]) == 200
+    assert inst.invoke("route", [2]) == 300
+    assert inst.invoke("route", [99]) == 300
+
+
+def test_memory_and_data_segment():
+    b = ModuleBuilder()
+    b.add_memory(1)
+    b.add_data(16, b"\x2a\x00\x00\x00")
+    b.add_function(
+        [I32], [I32], [], [Op.local_get(0), Op.i32_load()], export="peek"
+    )
+    b.add_function(
+        [I32, I32], [], [],
+        [Op.local_get(0), Op.local_get(1), Op.i32_store()],
+        export="poke",
+    )
+    inst = instantiate(b)
+    assert inst.invoke("peek", [16]) == 42
+    inst.invoke("poke", [100, 0xDEADBEEF])
+    assert inst.invoke("peek", [100]) == 0xDEADBEEF
+    with pytest.raises(WasmTrap):
+        inst.invoke("peek", [65536])  # out of bounds
+
+
+def test_memory_grow_and_size():
+    b = ModuleBuilder()
+    b.add_memory(1, 4)
+    b.add_function([], [I32], [], [Op.memory_size], export="size")
+    b.add_function(
+        [I32], [I32], [], [Op.local_get(0), Op.memory_grow], export="grow"
+    )
+    inst = instantiate(b)
+    assert inst.invoke("size", []) == 1
+    assert inst.invoke("grow", [2]) == 1
+    assert inst.invoke("size", []) == 3
+    assert inst.invoke("grow", [5]) == 0xFFFFFFFF  # over max -> -1
+
+
+def test_call_and_call_indirect():
+    b = ModuleBuilder()
+    dbl = b.add_function(
+        [I32], [I32], [], [Op.local_get(0), Op.i32_const(2), Op.i32_mul]
+    )
+    tri = b.add_function(
+        [I32], [I32], [], [Op.local_get(0), Op.i32_const(3), Op.i32_mul]
+    )
+    b.add_function(
+        [I32], [I32], [], [Op.local_get(0), Op.call(dbl)], export="twice"
+    )
+    ti = b.type_idx([I32], [I32])
+    b.add_function(
+        [I32, I32], [I32], [],
+        [Op.local_get(0), Op.local_get(1), Op.call_indirect(ti)],
+        export="apply",
+    )
+    b.add_table_funcs([dbl, tri])
+    inst = instantiate(b)
+    assert inst.invoke("twice", [21]) == 42
+    assert inst.invoke("apply", [10, 0]) == 20
+    assert inst.invoke("apply", [10, 1]) == 30
+    with pytest.raises(WasmTrap):
+        inst.invoke("apply", [10, 7])  # undefined table element
+
+
+def test_globals():
+    b = ModuleBuilder()
+    g = b.add_global(I32, True, [Op.i32_const(5)])
+    b.add_function([], [I32], [], [Op.global_get(g)], export="get")
+    b.add_function(
+        [I32], [], [], [Op.local_get(0), Op.global_set(g)], export="set"
+    )
+    inst = instantiate(b)
+    assert inst.invoke("get", []) == 5
+    inst.invoke("set", [77])
+    assert inst.invoke("get", []) == 77
+
+
+def test_i64_and_bit_ops():
+    b = ModuleBuilder()
+    b.add_function(
+        [I64, I64], [I64], [],
+        [Op.local_get(0), Op.local_get(1), Op.i64_mul],
+        export="mul64",
+    )
+    b.add_function(
+        [I32], [I32], [], [Op.local_get(0), b"\x69"], export="popcnt"
+    )
+    b.add_function(
+        [I32], [I32], [], [Op.local_get(0), b"\x67"], export="clz"
+    )
+    b.add_function(
+        [I32, I32], [I32], [],
+        [Op.local_get(0), Op.local_get(1), b"\x77"],
+        export="rotl",
+    )
+    inst = instantiate(b)
+    assert inst.invoke("mul64", [1 << 40, 1 << 30]) == (1 << 70) % (1 << 64)
+    assert inst.invoke("popcnt", [0b1011]) == 3
+    assert inst.invoke("clz", [1]) == 31
+    assert inst.invoke("clz", [0]) == 32
+    assert inst.invoke("rotl", [0x80000001, 1]) == 3
+
+
+def test_div_traps():
+    b = ModuleBuilder()
+    b.add_function(
+        [I32, I32], [I32], [],
+        [Op.local_get(0), Op.local_get(1), b"\x6d"],  # i32.div_s
+        export="div",
+    )
+    inst = instantiate(b)
+    assert inst.invoke("div", [7, 2]) == 3
+    assert inst.invoke("div", [0xFFFFFFF9, 2]) == 0xFFFFFFFD  # -7/2 = -3
+    with pytest.raises(WasmTrap):
+        inst.invoke("div", [1, 0])
+    with pytest.raises(WasmTrap):
+        inst.invoke("div", [0x80000000, 0xFFFFFFFF])  # INT_MIN / -1
+
+
+def test_unreachable_traps():
+    b = ModuleBuilder()
+    b.add_function([], [], [], [Op.unreachable], export="boom")
+    with pytest.raises(WasmTrap):
+        instantiate(b).invoke("boom", [])
+
+
+def test_gas_exhaustion():
+    b = ModuleBuilder()
+    # infinite loop
+    b.add_function([], [], [], [Op.loop(), Op.br(0), Op.end], export="spin")
+    inst = instantiate(b, gas=GasMeter(10_000))
+    with pytest.raises(OutOfGas):
+        inst.invoke("spin", [])
+    assert inst.gas.spent >= 10_000
+
+
+def test_host_import():
+    b = ModuleBuilder()
+    log = []
+    fi = b.add_import("env", "note", [I32], [])
+    b.add_function(
+        [I32], [], [],
+        [Op.local_get(0), Op.call(fi), Op.i32_const(99), Op.call(fi)],
+        export="run",
+    )
+    inst = instantiate(b, host={("env", "note"): lambda v: log.append(v)})
+    inst.invoke("run", [5])
+    assert log == [5, 99]
+
+
+# ---------------------------------------------------------------------------
+# contract-level: deploy + invoke through the executer
+# ---------------------------------------------------------------------------
+
+SEL_INC = abi.method_selector("inc()")
+SEL_GET = abi.method_selector("get()")
+
+
+def counter_contract() -> bytes:
+    """Counter: storage key = 32 zero bytes; value buffer holds an i64 (LE)
+    in the first 8 bytes of the 32-byte storage word.
+
+    Memory map: 0..3 selector | 64..95 key (zeros) | 96..127 value buffer."""
+    b = ModuleBuilder()
+    copy_call = b.add_import("env", "copy_call_value", [I32, I32, I32], [])
+    load_st = b.add_import("env", "load_storage", [I32, I32], [])
+    save_st = b.add_import("env", "save_storage", [I32, I32], [])
+    set_ret = b.add_import("env", "set_return", [I32, I32], [])
+    b.add_memory(1)
+    sel_inc = int.from_bytes(SEL_INC, "little")
+    sel_get = int.from_bytes(SEL_GET, "little")
+    body = [
+        # mem[0:4] = calldata[0:4]
+        Op.i32_const(0), Op.i32_const(4), Op.i32_const(0), Op.call(copy_call),
+        # load storage[key@64] into 96
+        Op.i32_const(64), Op.i32_const(96), Op.call(load_st),
+        # if selector == inc(): value += 1, save
+        Op.i32_const(0), Op.i32_load(), Op.i32_const(sel_inc), Op.i32_eq,
+        Op.if_(),
+        Op.i32_const(96),
+        Op.i32_const(96), Op.i64_load(), Op.i64_const(1), Op.i64_add,
+        Op.i64_store(),
+        Op.i32_const(64), Op.i32_const(96), Op.call(save_st),
+        Op.i32_const(96), Op.i32_const(8), Op.call(set_ret),
+        Op.return_,
+        Op.end,
+        # if selector == get(): return value
+        Op.i32_const(0), Op.i32_load(), Op.i32_const(sel_get), Op.i32_eq,
+        Op.if_(),
+        Op.i32_const(96), Op.i32_const(8), Op.call(set_ret),
+        Op.return_,
+        Op.end,
+        Op.unreachable,
+    ]
+    b.add_function([], [], [], body, export="start")
+    return b.build()
+
+
+def proxy_contract() -> bytes:
+    """Forwards calldata[20:] to the contract at calldata[0:20], then
+    propagates the child's return value."""
+    b = ModuleBuilder()
+    copy_call = b.add_import("env", "copy_call_value", [I32, I32, I32], [])
+    call_size = b.add_import("env", "get_call_size", [], [I32])
+    invoke = b.add_import(
+        "env", "invoke_contract", [I32, I32, I32, I32, I64], [I32]
+    )
+    ret_size = b.add_import("env", "get_return_size", [], [I32])
+    copy_ret = b.add_import("env", "copy_return_value", [I32, I32, I32], [])
+    set_ret = b.add_import("env", "set_return", [I32, I32], [])
+    b.add_memory(1)
+    # mem: 0..19 target addr | 32.. input | 512 value (zeros) | 1024 child ret
+    body = [
+        Op.i32_const(0), Op.i32_const(20), Op.i32_const(0), Op.call(copy_call),
+        Op.i32_const(20), Op.call(call_size), Op.i32_const(32), Op.call(copy_call),
+        Op.i32_const(0),  # addr off
+        Op.i32_const(32),  # input off
+        Op.call(call_size), Op.i32_const(20), Op.i32_sub,  # input len
+        Op.i32_const(512),  # value off (zeros)
+        Op.i64_const(0),  # gas: 0 -> all remaining
+        Op.call(invoke),
+        Op.i32_eqz, Op.if_(), Op.unreachable, Op.end,
+        # copy child return to 1024 and return it
+        Op.i32_const(1024), Op.i32_const(0), Op.call(ret_size), Op.call(copy_ret),
+        Op.i32_const(1024), Op.call(ret_size), Op.call(set_ret),
+    ]
+    b.add_function([], [], [], body, export="start")
+    return b.build()
+
+
+class Rng:
+    def __init__(self, seed=7):
+        import random
+
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def make_chain():
+    state = StateManager(MemoryKV())
+    snap = state.new_snapshot()
+    priv = ecdsa.generate_private_key(Rng())
+    addr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(priv))
+    execution.set_balance(snap, addr, 10**24)
+    executer = system_contracts.make_executer(CHAIN)
+    return snap, executer, priv, addr
+
+
+def _run_tx(snap, executer, priv, addr, nonce, *, to, invocation,
+            gas_limit=10**12, value=0):
+    tx = Transaction(
+        to=to, value=value, nonce=nonce, gas_price=1,
+        gas_limit=gas_limit, invocation=invocation,
+    )
+    stx = sign_transaction(tx, priv, CHAIN)
+    return executer.execute(snap, stx, block_index=1, index_in_block=0)
+
+
+def test_deploy_and_invoke_counter():
+    snap, executer, priv, addr = make_chain()
+    code = counter_contract()
+    res = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(code),
+    )
+    assert res.ok
+    caddr = res.receipt.return_data
+    assert len(caddr) == 20
+    assert get_code(snap, caddr) == code
+
+    for i in range(3):
+        res = _run_tx(snap, executer, priv, addr, 1 + i, to=caddr,
+                      invocation=SEL_INC)
+        assert res.ok, f"inc #{i} failed"
+        assert int.from_bytes(res.receipt.return_data, "little") == i + 1
+    res = _run_tx(snap, executer, priv, addr, 4, to=caddr, invocation=SEL_GET)
+    assert res.ok
+    assert int.from_bytes(res.receipt.return_data, "little") == 3
+    # VM gas shows up in the receipt
+    assert res.receipt.gas_used > execution.GAS_PER_TX
+
+
+def test_nested_invoke_via_proxy():
+    snap, executer, priv, addr = make_chain()
+    r1 = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(counter_contract()),
+    )
+    counter = r1.receipt.return_data
+    r2 = _run_tx(
+        snap, executer, priv, addr, 1,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(proxy_contract()),
+    )
+    proxy = r2.receipt.return_data
+    assert r1.ok and r2.ok and counter != proxy
+
+    res = _run_tx(snap, executer, priv, addr, 2, to=proxy,
+                  invocation=counter + SEL_INC)
+    assert res.ok
+    assert int.from_bytes(res.receipt.return_data, "little") == 1
+    # counter state mutated through the proxy
+    res = _run_tx(snap, executer, priv, addr, 3, to=counter, invocation=SEL_GET)
+    assert int.from_bytes(res.receipt.return_data, "little") == 1
+
+
+def test_bad_selector_fails_and_consumes_nonce():
+    snap, executer, priv, addr = make_chain()
+    res = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(counter_contract()),
+    )
+    caddr = res.receipt.return_data
+    res = _run_tx(snap, executer, priv, addr, 1, to=caddr, invocation=b"\xde\xad\xbe\xef")
+    assert not res.ok
+    assert execution.get_nonce(snap, addr) == 2  # nonce consumed
+    # storage untouched
+    res = _run_tx(snap, executer, priv, addr, 2, to=caddr, invocation=SEL_GET)
+    assert int.from_bytes(res.receipt.return_data, "little") == 0
+
+
+def test_out_of_gas_contract_call():
+    snap, executer, priv, addr = make_chain()
+    res = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(counter_contract()),
+    )
+    caddr = res.receipt.return_data
+    # storage ops cost ~millions of gas; 50k VM budget is not enough
+    res = _run_tx(snap, executer, priv, addr, 1, to=caddr,
+                  invocation=SEL_INC, gas_limit=execution.GAS_PER_TX + 50_000)
+    assert not res.ok
+
+
+def test_deploy_rejects_non_wasm():
+    snap, executer, priv, addr = make_chain()
+    res = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(b"not wasm"),
+    )
+    assert not res.ok
+
+
+def test_static_call_blocks_mutation():
+    snap, _, _, addr = make_chain()
+    code = counter_contract()
+    status, caddr = deploy_code(snap, addr, 0, code)
+    assert status == 1
+    machine = VirtualMachine(
+        snap, block_index=1, origin=addr, gas_price=1, chain_id=CHAIN
+    )
+    res = machine.invoke_contract(
+        contract=caddr, sender=addr, value=0, input=SEL_INC,
+        gas_limit=10**12, static=True,
+    )
+    assert res.status == 0  # save_storage trapped
+    res = machine.invoke_contract(
+        contract=caddr, sender=addr, value=0, input=SEL_GET,
+        gas_limit=10**12, static=True,
+    )
+    assert res.status == 1  # read path fine
+
+
+def test_abi_roundtrip():
+    blob = abi.encode_call("foo(address,uint256,bytes)", b"\x11" * 20, 42, b"xyz")
+    assert blob[:4] == abi.method_selector("foo(address,uint256,bytes)")
+    r = abi.AbiReader(blob, skip_selector=True)
+    assert r.address() == b"\x11" * 20
+    assert r.uint() == 42
+    assert r.bytes_() == b"xyz"
+    assert r.done()
