@@ -1,0 +1,212 @@
+"""A full networked node: consensus over TCP, pool gossip, era lifecycle.
+
+Parity with the reference's node wiring
+(/root/reference/src/Lachain.Core/Consensus/ConsensusManager.cs:191-360 era
+loop + Application.Start:67-198 service composition): each validator runs a
+NetworkManager (signed batches over the TCP hub), an EraRouter per era, a
+TransactionPool with gossip (BroadcastLocalTransaction role,
+NetworkManagerBase.cs:198-201), and produces blocks through RootProtocol.
+
+The consensus data plane (batched share verification) still runs through
+the JAX provider underneath the crypto layer; this module is host runtime.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from ..consensus import messages as M
+from ..consensus.era import EraRouter
+from ..consensus.keys import PrivateConsensusKeys, PublicConsensusKeys
+from ..consensus.root_protocol import RootProtocol
+from ..network import wire
+from ..network.hub import PeerAddress
+from ..network.manager import NetworkManager
+from ..storage.kv import KVStore, MemoryKV
+from ..storage.state import StateManager
+from .block_manager import BlockManager
+from .block_producer import BlockProducer
+from .execution import TransactionExecuter, get_nonce
+from .tx_pool import TransactionPool
+from .types import Block, SignedTransaction
+
+logger = logging.getLogger(__name__)
+
+
+class Node:
+    """One validator/observer process."""
+
+    def __init__(
+        self,
+        *,
+        index: int,
+        public_keys: PublicConsensusKeys,
+        private_keys: PrivateConsensusKeys,
+        chain_id: int,
+        kv: Optional[KVStore] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        txs_per_block: int = 1000,
+        initial_balances: Optional[Dict[bytes, int]] = None,
+        flush_interval: float = 0.02,
+        executer: Optional[TransactionExecuter] = None,
+    ):
+        self.index = index
+        self.public_keys = public_keys
+        self.private_keys = private_keys
+        self.chain_id = chain_id
+        self.kv = kv if kv is not None else MemoryKV()
+        self.state = StateManager(self.kv)
+        self.block_manager = BlockManager(
+            self.kv, self.state, executer or TransactionExecuter(chain_id)
+        )
+        self.block_manager.build_genesis(dict(initial_balances or {}), chain_id)
+        self.pool = TransactionPool(
+            self.kv, chain_id, account_nonce=self._account_nonce
+        )
+        self.producer = BlockProducer(
+            self.block_manager, self.pool, public_keys.n, txs_per_block
+        )
+        self.network = NetworkManager(
+            private_keys.ecdsa_priv, host, port, flush_interval=flush_interval
+        )
+        self.network.on_consensus = self._on_consensus
+        self.network.on_sync_pool_reply = self._on_pool_txs
+        self.network.on_ping_request = self._on_ping_request
+        # validator index <-> transport identity
+        self._pub_by_index: Dict[int, bytes] = {
+            i: pk for i, pk in enumerate(public_keys.ecdsa_pub_keys)
+        }
+        self._index_by_pub: Dict[bytes, int] = {
+            pk: i for i, pk in self._pub_by_index.items()
+        }
+        self.router: Optional[EraRouter] = None
+        self._era_done = asyncio.Event()
+        self._stopping = False
+
+    # -- service lifecycle --------------------------------------------------
+
+    async def start(self, first_era: int = 1) -> None:
+        await self.network.start()
+        # the router exists before the era loop runs so consensus traffic
+        # from faster peers is dispatched (or era-buffered), not dropped
+        self._ensure_router(first_era)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        await self.network.stop()
+
+    @property
+    def address(self) -> PeerAddress:
+        return self.network.address
+
+    def connect(self, peers: List[PeerAddress]) -> None:
+        for p in peers:
+            self.network.add_peer(p)
+
+    def _account_nonce(self, addr: bytes) -> int:
+        return get_nonce(self.state.new_snapshot(), addr)
+
+    # -- tx ingress + gossip -----------------------------------------------
+
+    def submit_tx(self, stx: SignedTransaction) -> bool:
+        ok = self.pool.add(stx)
+        if ok:
+            self.network.broadcast(wire.sync_pool_reply([stx]))
+        return ok
+
+    def _on_pool_txs(self, sender: bytes, txs: List[SignedTransaction]) -> None:
+        for stx in txs:
+            self.pool.add(stx)
+
+    def _on_ping_request(self, sender: bytes, height: int) -> None:
+        self.network.send_to(
+            sender, wire.ping_reply(self.block_manager.current_height())
+        )
+
+    # -- consensus plumbing -------------------------------------------------
+
+    def _transport_send(self, target: Optional[int], payload) -> None:
+        """EraRouter outbound: serialize + enqueue on peer workers; self
+        delivery is deferred onto the event loop to keep dispatch
+        non-reentrant (the reference's per-protocol queues give the same
+        guarantee)."""
+        assert self.router is not None
+        msg = wire.consensus_msg(self.router.era, payload)
+        loop = asyncio.get_running_loop()
+        if target is None:
+            self.network.broadcast(msg)
+            loop.call_soon(self._dispatch_local, self.router.era, payload)
+        elif target == self.index:
+            loop.call_soon(self._dispatch_local, self.router.era, payload)
+        else:
+            pub = self._pub_by_index.get(target)
+            if pub is not None:
+                self.network.send_to(pub, msg)
+
+    def _dispatch_local(self, era: int, payload) -> None:
+        if self.router is None or self._stopping:
+            return
+        self.router.dispatch_external(self.index, payload)
+        self._check_era_done()
+
+    def _on_consensus(self, sender_pub: bytes, era: int, payload) -> None:
+        sender = self._index_by_pub.get(sender_pub)
+        if sender is None:
+            logger.warning("consensus message from non-validator dropped")
+            return
+        if self.router is None:
+            return
+        self.router.dispatch_external(sender, payload)
+        self._check_era_done()
+
+    def _check_era_done(self) -> None:
+        if self.router is None:
+            return
+        pid = M.RootProtocolId(era=self.router.era)
+        if self.router.result_of(pid) is not None:
+            self._era_done.set()
+
+    def _root_factory(self, pid, router) -> RootProtocol:
+        return RootProtocol(
+            pid,
+            router,
+            producer=self.producer,
+            ecdsa_priv=self.private_keys.ecdsa_priv,
+            ecdsa_pubs=self.public_keys.ecdsa_pub_keys,
+        )
+
+    # -- era loop (ConsensusManager.Run) ------------------------------------
+
+    def _ensure_router(self, era: int) -> EraRouter:
+        if self.router is None:
+            self.router = EraRouter(
+                era,
+                self.index,
+                self.public_keys,
+                self.private_keys,
+                self._transport_send,
+                extra_factories={M.RootProtocolId: self._root_factory},
+            )
+        else:
+            self.router.advance_era(era)
+        return self.router
+
+    async def run_era(self, era: int, timeout: float = 120.0) -> Block:
+        """Run one era to completion; returns the produced block."""
+        router = self._ensure_router(era)
+        self._era_done.clear()
+        pid = M.RootProtocolId(era=era)
+        router.internal_request(
+            M.Request(from_id=None, to_id=pid, input=None)
+        )
+        self._check_era_done()
+        while router.result_of(pid) is None:
+            self._era_done.clear()
+            await asyncio.wait_for(self._era_done.wait(), timeout=timeout)
+        block = router.result_of(pid)
+        return block
+
+    async def run_eras(self, first: int, count: int) -> List[Block]:
+        return [await self.run_era(first + i) for i in range(count)]

@@ -1,0 +1,19 @@
+"""Networking layer: signed message batches over a TCP hub.
+
+Parity with /root/reference/src/Lachain.Networking (SURVEY.md §2f):
+wire.py = MessageBatch/MessageFactory + NetworkMessage oneof;
+hub.py = CommunicationHub equivalent; worker.py = ClientWorker;
+manager.py = NetworkManagerBase.
+"""
+from .hub import Hub, PeerAddress
+from .manager import NetworkManager
+from .wire import MessageBatch, MessageFactory, NetworkMessage
+
+__all__ = [
+    "Hub",
+    "PeerAddress",
+    "NetworkManager",
+    "MessageBatch",
+    "MessageFactory",
+    "NetworkMessage",
+]

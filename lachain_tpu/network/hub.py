@@ -1,0 +1,119 @@
+"""TCP transport hub: the CommunicationHub equivalent.
+
+Parity with the reference's Go CommunicationHub + HubConnector
+(/root/reference/src/Lachain.Networking/Hub/HubConnector.cs:26-105): the
+node hands the hub signed `MessageBatch` blobs addressed to a peer public
+key; the hub owns sockets, framing, dialing, and redelivery. The reference
+relays through external hub nodes; here peers connect directly over
+TCP/DCN (consensus traffic is control-plane KB-scale — ICI collectives are
+not a transport, SURVEY.md §5).
+
+Framing: 4-byte big-endian length + raw batch bytes.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+MAX_FRAME = 1 << 26  # 64 MiB
+
+
+@dataclass(frozen=True)
+class PeerAddress:
+    public_key: bytes  # 33-byte compressed ECDSA key (identity)
+    host: str
+    port: int
+
+
+class Hub:
+    """Owns the listening socket and outbound connections."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        on_batch: Callable[[bytes], None],
+    ):
+        self.host = host
+        self.port = port
+        self.on_batch = on_batch
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: Dict[Tuple[str, int], asyncio.StreamWriter] = {}
+        self._conn_locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+        self._reader_tasks: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_inbound, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]  # resolve port 0 -> actual
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # cancel inbound readers first: wait_closed() (3.12+) blocks until
+        # every connection handler returns
+        for t in list(self._reader_tasks):
+            t.cancel()
+        if self._reader_tasks:
+            await asyncio.gather(*self._reader_tasks, return_exceptions=True)
+        for w in list(self._conns.values()):
+            w.close()
+        self._conns.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    async def _handle_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._reader_tasks.add(task)
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                n = int.from_bytes(header, "big")
+                if n > MAX_FRAME:
+                    raise ValueError("oversized frame")
+                data = await reader.readexactly(n)
+                try:
+                    self.on_batch(data)
+                except Exception:
+                    logger.exception("batch handler failed")
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            writer.close()
+            if task is not None:
+                self._reader_tasks.discard(task)
+
+    async def send_raw(self, peer: PeerAddress, data: bytes) -> bool:
+        """Send one framed batch; dials on demand, drops the cached
+        connection on failure (next send re-dials)."""
+        key = (peer.host, peer.port)
+        lock = self._conn_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            writer = self._conns.get(key)
+            for attempt in (0, 1):
+                if writer is None:
+                    try:
+                        _, writer = await asyncio.open_connection(
+                            peer.host, peer.port
+                        )
+                        self._conns[key] = writer
+                    except OSError:
+                        return False
+                try:
+                    writer.write(len(data).to_bytes(4, "big") + data)
+                    await writer.drain()
+                    return True
+                except (ConnectionError, OSError):
+                    writer.close()
+                    self._conns.pop(key, None)
+                    writer = None
+            return False

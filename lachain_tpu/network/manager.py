@@ -1,0 +1,130 @@
+"""Network manager: peer registry, batch verification, event dispatch.
+
+Parity with the reference's NetworkManagerBase
+(/root/reference/src/Lachain.Networking/NetworkManagerBase.cs:96-196): a
+worker per peer public key, inbound batches are signature-verified then
+fanned out to per-kind event handlers; consensus `send_to` addresses
+validators by ECDSA public key (IConsensusMessageDeliverer.SendTo,
+NetworkManagerBase.cs:66-69).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from . import wire
+from .hub import Hub, PeerAddress
+from .wire import MessageBatch, MessageFactory, NetworkMessage
+from .worker import ClientWorker
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkManager:
+    def __init__(
+        self,
+        ecdsa_priv: bytes,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        flush_interval: float = 0.25,
+    ):
+        self.factory = MessageFactory(ecdsa_priv)
+        self.public_key = self.factory.public_key
+        self.hub = Hub(host, port, self._on_raw_batch)
+        self._flush_interval = flush_interval
+        self._workers: Dict[bytes, ClientWorker] = {}
+        # event handlers: fn(sender_pubkey, message)
+        self.on_consensus: Optional[Callable[[bytes, int, object], None]] = None
+        self.on_ping_request: Optional[Callable[[bytes, int], None]] = None
+        self.on_ping_reply: Optional[Callable[[bytes, int], None]] = None
+        self.on_sync_blocks_request: Optional[Callable] = None
+        self.on_sync_blocks_reply: Optional[Callable] = None
+        self.on_sync_pool_request: Optional[Callable] = None
+        self.on_sync_pool_reply: Optional[Callable] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.hub.start()
+
+    async def stop(self) -> None:
+        for w in self._workers.values():
+            await w.stop()
+        await self.hub.stop()
+
+    @property
+    def address(self) -> PeerAddress:
+        return PeerAddress(self.public_key, self.hub.host, self.hub.port)
+
+    def add_peer(self, peer: PeerAddress) -> None:
+        if peer.public_key == self.public_key:
+            return
+        if peer.public_key in self._workers:
+            return
+        worker = ClientWorker(
+            peer, self.factory, self.hub,
+            flush_interval=self._flush_interval,
+        )
+        self._workers[peer.public_key] = worker
+        worker.start()
+
+    @property
+    def peers(self) -> List[bytes]:
+        return list(self._workers.keys())
+
+    # -- sending -----------------------------------------------------------
+
+    def send_to(self, public_key: bytes, msg: NetworkMessage) -> None:
+        worker = self._workers.get(public_key)
+        if worker is None:
+            logger.warning("no worker for peer %s", public_key.hex()[:16])
+            return
+        worker.enqueue(msg)
+
+    def broadcast(self, msg: NetworkMessage) -> None:
+        for worker in self._workers.values():
+            worker.enqueue(msg)
+
+    # -- receiving ---------------------------------------------------------
+
+    def _on_raw_batch(self, data: bytes) -> None:
+        try:
+            batch = MessageBatch.decode(data)
+        except ValueError:
+            logger.warning("undecodable batch dropped")
+            return
+        if not batch.verify():
+            logger.warning("batch with bad signature dropped")
+            return
+        try:
+            msgs = batch.messages()
+        except (ValueError, zlib.error):
+            logger.warning("corrupt batch content dropped")
+            return
+        for msg in msgs:
+            try:
+                self._dispatch(batch.sender, msg)
+            except Exception:
+                logger.exception("message handler failed")
+
+    def _dispatch(self, sender: bytes, msg: NetworkMessage) -> None:
+        k = msg.kind
+        if k == wire.KIND_CONSENSUS and self.on_consensus:
+            era, payload = wire.parse_consensus(msg)
+            self.on_consensus(sender, era, payload)
+        elif k == wire.KIND_PING_REQUEST and self.on_ping_request:
+            self.on_ping_request(sender, wire.parse_height(msg))
+        elif k == wire.KIND_PING_REPLY and self.on_ping_reply:
+            self.on_ping_reply(sender, wire.parse_height(msg))
+        elif k == wire.KIND_SYNC_BLOCKS_REQUEST and self.on_sync_blocks_request:
+            start, count = wire.parse_sync_blocks_request(msg)
+            self.on_sync_blocks_request(sender, start, count)
+        elif k == wire.KIND_SYNC_BLOCKS_REPLY and self.on_sync_blocks_reply:
+            self.on_sync_blocks_reply(sender, wire.parse_sync_blocks_reply(msg))
+        elif k == wire.KIND_SYNC_POOL_REQUEST and self.on_sync_pool_request:
+            self.on_sync_pool_request(sender, wire.parse_sync_pool_request(msg))
+        elif k == wire.KIND_SYNC_POOL_REPLY and self.on_sync_pool_reply:
+            self.on_sync_pool_reply(sender, wire.parse_sync_pool_reply(msg))

@@ -1,0 +1,317 @@
+"""Network wire format: consensus payload codec + message kinds + batches.
+
+Parity with the reference's proto layer
+(/root/reference/src/Lachain.Proto/networking.proto — `NetworkMessage` oneof
+of 7 kinds, `MessageBatch{sender, signature, content}`;
+consensus.proto:77-91 — `ConsensusMessage` oneof of 9 payloads) using the
+framework's fixed-width codec instead of protobuf.
+
+A `MessageBatch` is the unit of transport: sender's compressed message list,
+ECDSA-signed (reference MessageFactory.cs:80-103, verified at
+NetworkManagerBase.cs:117-122; Deflate compression per HubConnector.cs:98).
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..consensus import messages as M
+from ..core.types import Block, SignedTransaction
+from ..crypto import ecdsa
+from ..crypto.hashes import keccak256
+from ..utils.serialization import (
+    Reader,
+    write_bytes,
+    write_bytes_list,
+    write_i64,
+    write_u32,
+    write_u64,
+)
+
+# ---------------------------------------------------------------------------
+# consensus payload codec (the ConsensusMessage oneof)
+# ---------------------------------------------------------------------------
+
+_VAL, _ECHO, _READY, _BVAL, _AUX, _CONF, _COIN, _DEC, _HDR = range(1, 10)
+
+
+def _enc_rbc(rbc: M.ReliableBroadcastId) -> bytes:
+    return write_i64(rbc.era) + write_u32(rbc.sender_id)
+
+
+def _dec_rbc(r: Reader) -> M.ReliableBroadcastId:
+    return M.ReliableBroadcastId(era=r.i64(), sender_id=r.u32())
+
+
+def _enc_bb(bb: M.BinaryBroadcastId) -> bytes:
+    return write_i64(bb.era) + write_i64(bb.agreement) + write_i64(bb.epoch)
+
+
+def _dec_bb(r: Reader) -> M.BinaryBroadcastId:
+    return M.BinaryBroadcastId(era=r.i64(), agreement=r.i64(), epoch=r.i64())
+
+
+def encode_payload(p) -> bytes:
+    if isinstance(p, M.ValMessage):
+        return (
+            bytes([_VAL])
+            + _enc_rbc(p.rbc)
+            + write_bytes(p.root)
+            + write_bytes_list(list(p.branch))
+            + write_bytes(p.shard)
+            + write_u32(p.shard_index)
+        )
+    if isinstance(p, M.EchoMessage):
+        return (
+            bytes([_ECHO])
+            + _enc_rbc(p.rbc)
+            + write_bytes(p.root)
+            + write_bytes_list(list(p.branch))
+            + write_bytes(p.shard)
+            + write_u32(p.shard_index)
+        )
+    if isinstance(p, M.ReadyMessage):
+        return bytes([_READY]) + _enc_rbc(p.rbc) + write_bytes(p.root)
+    if isinstance(p, M.BValMessage):
+        return bytes([_BVAL]) + _enc_bb(p.bb) + bytes([1 if p.value else 0])
+    if isinstance(p, M.AuxMessage):
+        return bytes([_AUX]) + _enc_bb(p.bb) + bytes([1 if p.value else 0])
+    if isinstance(p, M.ConfMessage):
+        mask = (1 if False in p.values else 0) | (2 if True in p.values else 0)
+        return bytes([_CONF]) + _enc_bb(p.bb) + bytes([mask])
+    if isinstance(p, M.CoinMessage):
+        c = p.coin
+        return (
+            bytes([_COIN])
+            + write_i64(c.era)
+            + write_i64(c.agreement)
+            + write_i64(c.epoch)
+            + write_bytes(p.share)
+        )
+    if isinstance(p, M.DecryptedMessage):
+        return (
+            bytes([_DEC])
+            + write_i64(p.hb.era)
+            + write_u32(p.share_id)
+            + write_bytes(p.payload)
+        )
+    if isinstance(p, M.SignedHeaderMessage):
+        return (
+            bytes([_HDR])
+            + write_i64(p.root.era)
+            + write_bytes(p.header_bytes)
+            + write_bytes(p.signature)
+        )
+    raise TypeError(f"unencodable payload {type(p)}")
+
+
+def decode_payload(data: bytes):
+    r = Reader(data)
+    tag = r.raw(1)[0]
+    if tag in (_VAL, _ECHO):
+        rbc = _dec_rbc(r)
+        root = r.bytes_()
+        branch = tuple(r.bytes_list())
+        shard = r.bytes_()
+        idx = r.u32()
+        cls = M.ValMessage if tag == _VAL else M.EchoMessage
+        return cls(rbc=rbc, root=root, branch=branch, shard=shard, shard_index=idx)
+    if tag == _READY:
+        return M.ReadyMessage(rbc=_dec_rbc(r), root=r.bytes_())
+    if tag == _BVAL:
+        return M.BValMessage(bb=_dec_bb(r), value=r.raw(1)[0] != 0)
+    if tag == _AUX:
+        return M.AuxMessage(bb=_dec_bb(r), value=r.raw(1)[0] != 0)
+    if tag == _CONF:
+        bb = _dec_bb(r)
+        mask = r.raw(1)[0]
+        vals = frozenset(
+            v for v, bit in ((False, 1), (True, 2)) if mask & bit
+        )
+        return M.ConfMessage(bb=bb, values=vals)
+    if tag == _COIN:
+        coin = M.CoinId(era=r.i64(), agreement=r.i64(), epoch=r.i64())
+        return M.CoinMessage(coin=coin, share=r.bytes_())
+    if tag == _DEC:
+        hb = M.HoneyBadgerId(era=r.i64())
+        return M.DecryptedMessage(hb=hb, share_id=r.u32(), payload=r.bytes_())
+    if tag == _HDR:
+        root = M.RootProtocolId(era=r.i64())
+        return M.SignedHeaderMessage(
+            root=root, header_bytes=r.bytes_(), signature=r.bytes_()
+        )
+    raise ValueError(f"unknown payload tag {tag}")
+
+
+# ---------------------------------------------------------------------------
+# network messages (the NetworkMessage oneof) + priorities
+# ---------------------------------------------------------------------------
+
+KIND_CONSENSUS = 1
+KIND_PING_REQUEST = 2
+KIND_PING_REPLY = 3
+KIND_SYNC_BLOCKS_REQUEST = 4
+KIND_SYNC_BLOCKS_REPLY = 5
+KIND_SYNC_POOL_REQUEST = 6
+KIND_SYNC_POOL_REPLY = 7
+
+# reference NetworkMessagePriority: replies < consensus < pool sync
+PRIORITY = {
+    KIND_PING_REPLY: 0,
+    KIND_SYNC_BLOCKS_REPLY: 0,
+    KIND_SYNC_POOL_REPLY: 0,
+    KIND_CONSENSUS: 1,
+    KIND_PING_REQUEST: 2,
+    KIND_SYNC_BLOCKS_REQUEST: 2,
+    KIND_SYNC_POOL_REQUEST: 2,
+}
+
+
+@dataclass(frozen=True)
+class NetworkMessage:
+    kind: int
+    body: bytes  # kind-specific encoding
+
+    def encode(self) -> bytes:
+        return bytes([self.kind]) + write_bytes(self.body)
+
+    @classmethod
+    def decode_from(cls, r: Reader) -> "NetworkMessage":
+        kind = r.raw(1)[0]
+        if kind not in PRIORITY:
+            raise ValueError(f"unknown message kind {kind}")
+        return cls(kind=kind, body=r.bytes_())
+
+
+def consensus_msg(era: int, payload) -> NetworkMessage:
+    return NetworkMessage(
+        KIND_CONSENSUS, write_i64(era) + encode_payload(payload)
+    )
+
+
+def parse_consensus(msg: NetworkMessage) -> Tuple[int, object]:
+    r = Reader(msg.body)
+    era = r.i64()
+    return era, decode_payload(r.rest())
+
+
+def ping_request(height: int) -> NetworkMessage:
+    return NetworkMessage(KIND_PING_REQUEST, write_u64(height))
+
+
+def ping_reply(height: int) -> NetworkMessage:
+    return NetworkMessage(KIND_PING_REPLY, write_u64(height))
+
+
+def parse_height(msg: NetworkMessage) -> int:
+    return Reader(msg.body).u64()
+
+
+def sync_blocks_request(start: int, count: int) -> NetworkMessage:
+    return NetworkMessage(
+        KIND_SYNC_BLOCKS_REQUEST, write_u64(start) + write_u32(count)
+    )
+
+
+def parse_sync_blocks_request(msg: NetworkMessage) -> Tuple[int, int]:
+    r = Reader(msg.body)
+    return r.u64(), r.u32()
+
+
+def sync_blocks_reply(blocks: List[Tuple[Block, List[SignedTransaction]]]) -> NetworkMessage:
+    out = write_u32(len(blocks))
+    for block, txs in blocks:
+        out += write_bytes(block.encode())
+        out += write_bytes_list([t.encode() for t in txs])
+    return NetworkMessage(KIND_SYNC_BLOCKS_REPLY, out)
+
+
+def parse_sync_blocks_reply(
+    msg: NetworkMessage,
+) -> List[Tuple[Block, List[SignedTransaction]]]:
+    r = Reader(msg.body)
+    out = []
+    for _ in range(r.u32()):
+        block = Block.decode(r.bytes_())
+        txs = [SignedTransaction.decode(t) for t in r.bytes_list()]
+        out.append((block, txs))
+    return out
+
+
+def sync_pool_request(hashes: List[bytes]) -> NetworkMessage:
+    return NetworkMessage(KIND_SYNC_POOL_REQUEST, write_bytes_list(hashes))
+
+
+def parse_sync_pool_request(msg: NetworkMessage) -> List[bytes]:
+    return Reader(msg.body).bytes_list()
+
+
+def sync_pool_reply(txs: List[SignedTransaction]) -> NetworkMessage:
+    return NetworkMessage(
+        KIND_SYNC_POOL_REPLY, write_bytes_list([t.encode() for t in txs])
+    )
+
+
+def parse_sync_pool_reply(msg: NetworkMessage) -> List[SignedTransaction]:
+    return [SignedTransaction.decode(t) for t in Reader(msg.body).bytes_list()]
+
+
+# ---------------------------------------------------------------------------
+# signed batches
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MessageBatch:
+    sender: bytes  # 33-byte compressed ECDSA pubkey
+    signature: bytes  # 65-byte recoverable sig over keccak(content)
+    content: bytes  # zlib-compressed encoded message list
+
+    def encode(self) -> bytes:
+        return (
+            write_bytes(self.sender)
+            + write_bytes(self.signature)
+            + write_bytes(self.content)
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "MessageBatch":
+        r = Reader(data)
+        sender = r.bytes_()
+        sig = r.bytes_()
+        content = r.bytes_()
+        r.assert_eof()
+        return cls(sender, sig, content)
+
+    def verify(self) -> bool:
+        return ecdsa.verify_hash(
+            self.sender, keccak256(self.content), self.signature
+        )
+
+    def messages(self) -> List[NetworkMessage]:
+        raw = zlib.decompress(self.content, bufsize=1 << 20)
+        if len(raw) > 1 << 26:
+            raise ValueError("batch too large")
+        r = Reader(raw)
+        out = []
+        for _ in range(r.u32()):
+            out.append(NetworkMessage.decode_from(r))
+        r.assert_eof()
+        return out
+
+
+class MessageFactory:
+    """Builds + signs message batches (reference MessageFactory.cs:13-103)."""
+
+    def __init__(self, ecdsa_priv: bytes):
+        self._priv = ecdsa_priv
+        self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
+
+    def batch(self, msgs: List[NetworkMessage]) -> MessageBatch:
+        raw = write_u32(len(msgs)) + b"".join(m.encode() for m in msgs)
+        content = zlib.compress(raw, level=1)
+        sig = ecdsa.sign_hash(self._priv, keccak256(content))
+        return MessageBatch(
+            sender=self.public_key, signature=sig, content=content
+        )

@@ -1,0 +1,96 @@
+"""Per-peer send worker: priority queue + size/time batching.
+
+Parity with the reference's ClientWorker
+(/root/reference/src/Lachain.Networking/Hub/ClientWorker.cs:38-143): one
+worker per peer, an interval-heap priority queue, batches capped at 64 KiB
+flushed at ~4 Hz — but as an asyncio task instead of a thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import List, Optional
+
+from .hub import Hub, PeerAddress
+from .wire import MessageBatch, MessageFactory, NetworkMessage, PRIORITY
+
+MAX_BATCH_BYTES = 64 * 1024
+FLUSH_INTERVAL = 0.25
+
+
+class ClientWorker:
+    def __init__(
+        self,
+        peer: PeerAddress,
+        factory: MessageFactory,
+        hub: Hub,
+        *,
+        flush_interval: float = FLUSH_INTERVAL,
+        max_batch_bytes: int = MAX_BATCH_BYTES,
+    ):
+        self.peer = peer
+        self._factory = factory
+        self._hub = hub
+        self._flush_interval = flush_interval
+        self._max_batch_bytes = max_batch_bytes
+        self._heap: List = []
+        self._seq = itertools.count()
+        self._wakeup = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._wakeup.set()
+        if self._task is not None:
+            await self._task
+
+    def enqueue(self, msg: NetworkMessage) -> None:
+        heapq.heappush(
+            self._heap, (PRIORITY[msg.kind], next(self._seq), msg)
+        )
+        # wake immediately once a batch's worth is pending
+        pending = sum(len(m.body) + 6 for _, _, m in self._heap)
+        if pending >= self._max_batch_bytes:
+            self._wakeup.set()
+
+    def _drain_batch(self) -> List[NetworkMessage]:
+        out: List[NetworkMessage] = []
+        size = 0
+        while self._heap and size < self._max_batch_bytes:
+            _, _, msg = heapq.heappop(self._heap)
+            out.append(msg)
+            size += len(msg.body) + 6
+        return out
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                await asyncio.wait_for(
+                    self._wakeup.wait(), timeout=self._flush_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wakeup.clear()
+            while self._heap:
+                msgs = self._drain_batch()
+                batch: MessageBatch = self._factory.batch(msgs)
+                ok = await self._hub.send_raw(self.peer, batch.encode())
+                if not ok:
+                    # peer unreachable: requeue and back off; consensus
+                    # retransmission is handled at the protocol layer
+                    for m in msgs:
+                        heapq.heappush(
+                            self._heap,
+                            (PRIORITY[m.kind], next(self._seq), m),
+                        )
+                    await asyncio.sleep(self._flush_interval)
+                    break
+        # final flush on stop
+        if self._heap:
+            msgs = self._drain_batch()
+            await self._hub.send_raw(self.peer, self._factory.batch(msgs).encode())

@@ -78,6 +78,10 @@ class Reader:
     def raw(self, n: int) -> bytes:
         return self._take(n)
 
+    def rest(self) -> bytes:
+        """Everything remaining (consumes it)."""
+        return self._take(len(self._d) - self._o)
+
     def eof(self) -> bool:
         return self._o == len(self._d)
 

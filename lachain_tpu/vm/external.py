@@ -187,23 +187,21 @@ def build_env(vm, frame) -> HostTable:
         to = read(addr_off, ADDR)
         data = read(input_off, input_len)
         value = int.from_bytes(read(value_off, WORD), "big")
-        if value and not static:
+        if value and static:
+            raise WasmTrap("value transfer in static call")
+        if value:
             require_mutable()
-            bal = execution.get_balance(vm.snap, frame.contract)
-            if bal < value:
-                return 0
-            execution.set_balance(vm.snap, frame.contract, bal - value)
-            execution.set_balance(
-                vm.snap, to, execution.get_balance(vm.snap, to) + value
-            )
+        # the value moves inside the child frame's checkpoint (value_from),
+        # so a failed call reverts the transfer with everything else
         res = vm.invoke_contract(
             contract=to,
             sender=frame.contract if not delegate else frame.sender,
             value=value,
             input=data,
-            gas_limit=min(gas_limit, vm.gas.remaining) if gas_limit else vm.gas.remaining,
+            gas_limit=gas_limit if gas_limit else 0,
             static=static,
             storage_owner=frame.storage_owner if delegate else None,
+            value_from=frame.contract if value else None,
         )
         frame.child_return = res.return_data
         return res.status
@@ -228,16 +226,18 @@ def build_env(vm, frame) -> HostTable:
 
         charge(G.DEPLOY_GAS + code_len * G.DEPLOY_GAS_PER_BYTE)
         code = read(code_off, code_len)
+        # endowment must be payable BEFORE any state is written, so a
+        # failed create leaves neither code nor a half-made transfer
+        value = int.from_bytes(read(value_off, WORD), "big")
+        bal = execution.get_balance(vm.snap, frame.contract)
+        if bal < value:
+            return 0
         nonce = execution.get_nonce(vm.snap, frame.contract)
         execution.set_nonce(vm.snap, frame.contract, nonce + 1)
         status, addr = deploy_code(vm.snap, frame.contract, nonce, code)
         if status != 1:
-            return 0
-        value = int.from_bytes(read(value_off, WORD), "big")
+            return 0  # nonce is consumed, as in the account-create rules
         if value:
-            bal = execution.get_balance(vm.snap, frame.contract)
-            if bal < value:
-                return 0
             execution.set_balance(vm.snap, frame.contract, bal - value)
             execution.set_balance(vm.snap, addr, value)
         write(result_off, addr)
@@ -251,6 +251,10 @@ def build_env(vm, frame) -> HostTable:
         charge(G.DEPLOY_GAS + code_len * G.DEPLOY_GAS_PER_BYTE)
         code = read(code_off, code_len)
         salt = read(salt_off, WORD)
+        value = int.from_bytes(read(value_off, WORD), "big")
+        bal = execution.get_balance(vm.snap, frame.contract)
+        if bal < value:
+            return 0
         try:
             module = decode_module(code)
         except WasmDecodeError:
@@ -261,11 +265,7 @@ def build_env(vm, frame) -> HostTable:
         if get_code(vm.snap, addr) is not None:
             return 0
         set_code(vm.snap, addr, code)
-        value = int.from_bytes(read(value_off, WORD), "big")
         if value:
-            bal = execution.get_balance(vm.snap, frame.contract)
-            if bal < value:
-                return 0
             execution.set_balance(vm.snap, frame.contract, bal - value)
             execution.set_balance(vm.snap, addr, value)
         write(result_off, addr)

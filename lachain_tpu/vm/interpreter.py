@@ -205,7 +205,6 @@ class Instance:
             if off + len(seg.data) > len(self.memory):
                 raise WasmTrap("data segment out of bounds")
             self.memory[off : off + len(seg.data)] = seg.data
-        self._sidetables: Dict[int, Tuple[Dict[int, int], Dict[int, int]]] = {}
         self._depth = 0
         if module.start is not None:
             self.call_index(module.start, [])
@@ -282,11 +281,12 @@ class Instance:
         self, fn: Function, ftype: FuncType, args: List[object]
     ) -> Optional[object]:
         body = fn.body
-        fid = id(fn)
-        tables = self._sidetables.get(fid)
+        # sidetable cached on the Function itself: shared across Instances
+        # (modules are cached per code hash in vm.py)
+        tables = getattr(fn, "_sidetable", None)
         if tables is None:
             tables = _build_sidetable(body)
-            self._sidetables[fid] = tables
+            fn._sidetable = tables
         end_of, else_of = tables
 
         locals_: List[object] = args
@@ -298,7 +298,6 @@ class Instance:
         ctrl: List[Tuple[int, int, int]] = []
         pc = 0
         charge = self.gas.charge
-        mem = self.memory
         n_body = len(body)
 
         while pc < n_body:
@@ -485,7 +484,6 @@ class Instance:
                     charge(256 * delta)  # growth is not free
                     self.mem_pages = old + delta
                     self.memory.extend(bytes(delta * PAGE_SIZE))
-                    mem = self.memory
                     stack.append(old)
                 pc += 1
                 continue

@@ -23,6 +23,29 @@ MAX_FRAME_DEPTH = 16
 
 CODE_PREFIX = b"c:"  # 'contracts' subtree: code by address
 
+# decoded-module cache: Module objects are immutable after decode, so
+# repeated/nested invocations skip the binary re-parse (keyed by code hash)
+_MODULE_CACHE: "OrderedDict[bytes, object]" = None  # type: ignore[assignment]
+_MODULE_CACHE_MAX = 64
+
+
+def _decode_cached(code: bytes):
+    global _MODULE_CACHE
+    if _MODULE_CACHE is None:
+        from collections import OrderedDict
+
+        _MODULE_CACHE = OrderedDict()
+    key = keccak256(code)
+    mod = _MODULE_CACHE.get(key)
+    if mod is None:
+        mod = decode_module(code)
+        _MODULE_CACHE[key] = mod
+        if len(_MODULE_CACHE) > _MODULE_CACHE_MAX:
+            _MODULE_CACHE.popitem(last=False)
+    else:
+        _MODULE_CACHE.move_to_end(key)
+    return mod
+
 
 class HaltException(Exception):
     def __init__(self, code: int):
@@ -119,8 +142,14 @@ class VirtualMachine:
         static: bool = False,
         code: Optional[bytes] = None,
         storage_owner: Optional[bytes] = None,
+        value_from: Optional[bytes] = None,
     ) -> InvocationResult:
-        """Run the `start` export of the contract at `contract`."""
+        """Run the `start` export of the contract at `contract`.
+
+        `value_from`: debit/credit the call value inside this frame's
+        checkpoint, so a failed call reverts the transfer too (the
+        reference's per-frame snapshot/rollback gives the same guarantee).
+        """
         if len(self.frames) >= MAX_FRAME_DEPTH:
             return InvocationResult(status=0, gas_used=0, return_data=b"")
         code = code if code is not None else get_code(self.snap, contract)
@@ -132,6 +161,12 @@ class VirtualMachine:
             self.events = []
         meter = self.gas
         assert meter is not None
+        # a nested call's gas limit bounds the CHILD's spend only: the
+        # parent's limit is restored afterwards, so a child OutOfGas does
+        # not poison the parent's meter
+        outer_limit = meter.limit
+        if not top_level and gas_limit:
+            meter.limit = min(outer_limit, meter.spent + gas_limit)
         frame = ExecutionFrame(
             contract=contract,
             storage_owner=storage_owner or contract,
@@ -145,19 +180,39 @@ class VirtualMachine:
         n_events = len(self.events)
         start_gas = meter.spent
         try:
-            meter.charge(len(input) * G.INPUT_DATA_GAS_PER_BYTE)
-            module = decode_module(code)
-            frame.instance = Instance(module, host=build_env(self, frame), gas=meter)
-            frame.instance.invoke("start", [])
             status = 1
+            if value and value_from is not None:
+                from ..core import execution
+
+                bal = execution.get_balance(self.snap, value_from)
+                if bal < value:
+                    status = 0
+                else:
+                    execution.set_balance(self.snap, value_from, bal - value)
+                    execution.set_balance(
+                        self.snap,
+                        contract,
+                        execution.get_balance(self.snap, contract) + value,
+                    )
+            if status == 1:
+                meter.charge(len(input) * G.INPUT_DATA_GAS_PER_BYTE)
+                module = _decode_cached(code)
+                frame.instance = Instance(
+                    module, host=build_env(self, frame), gas=meter
+                )
+                frame.instance.invoke("start", [])
         except HaltException as e:
             status = 1 if e.code == 0 else 0
         except OutOfGas:
             status = 0
-        except (WasmTrap, WasmDecodeError, RecursionError):
+        except Exception:
+            # any interpreter/host fault (including malformed-but-decodable
+            # bytecode hitting IndexError/TypeError/struct.error) is a
+            # deterministic trap, never a node crash
             status = 0
         finally:
             self.frames.pop()
+            meter.limit = outer_limit
         gas_used = meter.spent - start_gas
         if status != 1:
             self.snap.restore(cp)

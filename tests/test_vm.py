@@ -486,3 +486,94 @@ def test_abi_roundtrip():
     assert r.uint() == 42
     assert r.bytes_() == b"xyz"
     assert r.done()
+
+
+def test_malformed_bytecode_is_trap_not_crash():
+    """Decodable-but-invalid bytecode (drop on empty stack) must fail the tx
+    deterministically, never raise out of the executor."""
+    snap, executer, priv, addr = make_chain()
+    b = ModuleBuilder()
+    b.add_function([], [], [], [Op.drop], export="start")
+    res = _run_tx(
+        snap, executer, priv, addr, 0,
+        to=system_contracts.DEPLOY_ADDRESS,
+        invocation=system_contracts.SEL_DEPLOY + write_bytes(b.build()),
+    )
+    assert res.ok  # deploy validates structure, not types
+    caddr = res.receipt.return_data
+    res = _run_tx(snap, executer, priv, addr, 1, to=caddr, invocation=b"\x00" * 4)
+    assert not res.ok  # trapped, not crashed
+
+
+def test_nested_call_value_reverts_on_child_trap():
+    """A failed nested call must revert its value transfer (the transfer
+    happens inside the child frame's checkpoint)."""
+    snap, _, _, addr = make_chain()
+    # child: always traps
+    cb = ModuleBuilder()
+    cb.add_function([], [], [], [Op.unreachable], export="start")
+    status, child = deploy_code(snap, addr, 0, cb.build())
+    assert status == 1
+    # parent: invoke child with value=100 from memory, return child status
+    pb = ModuleBuilder()
+    invoke = pb.add_import("env", "invoke_contract", [I32, I32, I32, I32, I64], [I32])
+    set_ret = pb.add_import("env", "set_return", [I32, I32], [])
+    pb.add_memory(1)
+    pb.add_data(0, child)  # child address at 0
+    pb.add_data(63, b"\x64")  # value word at 32..63 = 100 (big-endian)
+    body = [
+        Op.i32_const(0), Op.i32_const(512), Op.i32_const(0), Op.i32_const(32),
+        Op.i64_const(0), Op.call(invoke),
+        # store status at 128 and return it
+        Op.i32_const(128), b"\x1a"[0:0],  # (no-op filler removed)
+    ]
+    # simpler: status -> memory via local
+    body = [
+        Op.i32_const(128),
+        Op.i32_const(0), Op.i32_const(512), Op.i32_const(0), Op.i32_const(32),
+        Op.i64_const(0), Op.call(invoke),
+        Op.i32_store(),
+        Op.i32_const(128), Op.i32_const(4), Op.call(set_ret),
+    ]
+    pb.add_function([], [], [], body, export="start")
+    status, parent = deploy_code(snap, addr, 1, pb.build())
+    assert status == 1
+    execution.set_balance(snap, parent, 1000)
+    machine = VirtualMachine(snap, block_index=1, origin=addr, gas_price=1, chain_id=CHAIN)
+    res = machine.invoke_contract(
+        contract=parent, sender=addr, value=0, input=b"\x00" * 4, gas_limit=10**12
+    )
+    assert res.status == 1
+    assert int.from_bytes(res.return_data, "little") == 0  # child failed
+    assert execution.get_balance(snap, parent) == 1000  # transfer reverted
+    assert execution.get_balance(snap, child) == 0
+
+
+def test_nested_gas_cap_does_not_poison_parent():
+    """A child OutOfGas under an explicit per-call cap must leave the parent
+    able to continue."""
+    snap, _, _, addr = make_chain()
+    # child: infinite loop
+    cb = ModuleBuilder()
+    cb.add_function([], [], [], [Op.loop(), Op.br(0), Op.end], export="start")
+    status, child = deploy_code(snap, addr, 0, cb.build())
+    # parent: call child with tiny gas cap, then return 42 on its own
+    pb = ModuleBuilder()
+    invoke = pb.add_import("env", "invoke_contract", [I32, I32, I32, I32, I64], [I32])
+    set_ret = pb.add_import("env", "set_return", [I32, I32], [])
+    pb.add_memory(1)
+    pb.add_data(0, child)
+    body = [
+        Op.i32_const(0), Op.i32_const(512), Op.i32_const(0), Op.i32_const(32),
+        Op.i64_const(50_000), Op.call(invoke), Op.drop,
+        Op.i32_const(128), Op.i32_const(42), Op.i32_store(),
+        Op.i32_const(128), Op.i32_const(4), Op.call(set_ret),
+    ]
+    pb.add_function([], [], [], body, export="start")
+    status, parent = deploy_code(snap, addr, 1, pb.build())
+    machine = VirtualMachine(snap, block_index=1, origin=addr, gas_price=1, chain_id=CHAIN)
+    res = machine.invoke_contract(
+        contract=parent, sender=addr, value=0, input=b"\x00" * 4, gas_limit=10**9
+    )
+    assert res.status == 1
+    assert int.from_bytes(res.return_data, "little") == 42
