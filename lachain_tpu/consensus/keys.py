@@ -31,12 +31,55 @@ class PublicConsensusKeys:
         assert len(self.tpke_verification_keys) == self.n
         assert self.ts_keys.n == self.n
 
+    def encode(self) -> bytes:
+        """Wire form — this is the blob keygen CONFIRM votes ride on and
+        ChangeValidators installs (reference ConsensusState,
+        Utility/ConsensusState.cs:9-60)."""
+        from ..utils.serialization import write_bytes, write_bytes_list, write_u32
+
+        return (
+            write_u32(self.n)
+            + write_u32(self.f)
+            + write_bytes(self.tpke_pub.to_bytes())
+            + write_bytes_list([k.to_bytes() for k in self.tpke_verification_keys])
+            + write_bytes(self.ts_keys.to_bytes())
+            + write_bytes_list(list(self.ecdsa_pub_keys))
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PublicConsensusKeys":
+        from ..utils.serialization import Reader
+
+        r = Reader(data)
+        n = r.u32()
+        f = r.u32()
+        tpke_pub = tpke.TpkePublicKey.from_bytes(r.bytes_())
+        vks = [tpke.TpkeVerificationKey.from_bytes(b) for b in r.bytes_list()]
+        ts_keys = ts.TsPublicKeySet.from_bytes(r.bytes_())
+        ecdsa_pubs = r.bytes_list()
+        r.assert_eof()
+        return cls(
+            n=n,
+            f=f,
+            tpke_pub=tpke_pub,
+            tpke_verification_keys=vks,
+            ts_keys=ts_keys,
+            ecdsa_pub_keys=ecdsa_pubs,
+        )
+
 
 @dataclass
 class PrivateConsensusKeys:
-    tpke_priv: tpke.TpkePrivateKey
-    ts_share: ts.TsPrivateKeyShare
+    """A node's secret material. Observers (sync-only nodes) carry just an
+    ECDSA identity — the threshold shares are None."""
+
+    tpke_priv: Optional[tpke.TpkePrivateKey] = None
+    ts_share: Optional[ts.TsPrivateKeyShare] = None
     ecdsa_priv: Optional[bytes] = None
+
+    @classmethod
+    def observer(cls, ecdsa_priv: bytes) -> "PrivateConsensusKeys":
+        return cls(ecdsa_priv=ecdsa_priv)
 
 
 def trusted_key_gen(n: int, f: int, rng=None):

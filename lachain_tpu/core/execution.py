@@ -107,7 +107,9 @@ class TransactionExecuter:
         if tx.to in self.system_contracts:
             handler = self.system_contracts[tx.to]
             try:
-                status, ret = handler(snap, sender, tx, block_index)
+                status, ret = handler(
+                    snap, sender, tx, block_index, tx_hash=tx_hash
+                )
             except Exception:
                 status, ret = 0, b""
             if status != 1:

@@ -1,0 +1,178 @@
+"""KeyGenManager: drives the on-chain DKG from system-contract events.
+
+Parity with the reference's manager
+(/root/reference/src/Lachain.Core/Vault/KeyGenManager.cs:77-260): watch
+executed blocks for staking/governance events and answer with the next
+keygen transaction —
+
+  lottery_done       -> if elected, new TrustlessKeygen + COMMIT tx
+  keygen_commit      -> handle_commit  -> SEND_VALUE tx
+  keygen_value       -> handle_send_value; once finished -> CONFIRM tx
+                        carrying the derived public key set
+  validators_changed -> install the keyring shares into the wallet for the
+                        next cycle's eras (PrivateWallet era-keyed store)
+
+The manager is transport-agnostic: `send_tx(to, invocation)` is provided by
+the node (it builds, signs, pools, and gossips the transaction).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from ..consensus.keygen import CommitMessage, ThresholdKeyring, TrustlessKeygen, ValueMessage
+from ..crypto import ecdsa
+from ..storage.state import Snapshot
+from ..utils.serialization import Reader, write_bytes, write_u32, write_u256
+from . import system_contracts as sc
+from .types import Block
+
+logger = logging.getLogger(__name__)
+
+
+class KeyGenManager:
+    def __init__(
+        self,
+        ecdsa_priv: bytes,
+        send_tx: Callable[[bytes, bytes], None],
+        *,
+        cycle_duration: Optional[int] = None,
+        on_keys: Optional[Callable[[int, ThresholdKeyring, List[bytes]], None]] = None,
+        rng=None,
+    ):
+        self._priv = ecdsa_priv
+        self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
+        self.address = ecdsa.address_from_public_key(self.public_key)
+        self._send_tx = send_tx
+        self._cycle_duration = cycle_duration or sc.CYCLE_DURATION
+        self._on_keys = on_keys  # (first_era, keyring, participant_pubkeys)
+        self._rng = rng
+        self.keygen: Optional[TrustlessKeygen] = None
+        self._participants: List[bytes] = []
+        self._addr_to_idx: Dict[bytes, int] = {}
+        self._keyring: Optional[ThresholdKeyring] = None
+        self._cycle: Optional[int] = None
+        self._installed_cycles: set = set()
+
+    # -- block hook ---------------------------------------------------------
+
+    def on_block_persisted(self, block: Block, snap: Snapshot) -> None:
+        """Scan the block's executed events and react (reference
+        BlockManagerOnSystemContractInvoked, KeyGenManager.cs:77-107)."""
+        for tx_hash in block.tx_hashes:
+            i = 0
+            while True:
+                raw = snap.get("events", tx_hash + write_u32(i))
+                if raw is None:
+                    break
+                i += 1
+                try:
+                    self._handle_event(raw[:20], raw[20:], block, snap)
+                except Exception:
+                    logger.exception("keygen event handling failed")
+
+    def _handle_event(
+        self, contract: bytes, payload: bytes, block: Block, snap: Snapshot
+    ) -> None:
+        if contract == sc.STAKING_ADDRESS and payload.startswith(b"lottery_done"):
+            self._on_lottery_done(block, snap)
+        elif contract == sc.GOVERNANCE_ADDRESS and payload.startswith(b"keygen_commit"):
+            rest = payload[len(b"keygen_commit") :]
+            self._on_commit(rest[:20], rest[20:])
+        elif contract == sc.GOVERNANCE_ADDRESS and payload.startswith(b"keygen_value"):
+            rest = payload[len(b"keygen_value") :]
+            self._on_value(rest[:20], rest[20:])
+        elif contract == sc.GOVERNANCE_ADDRESS and payload.startswith(
+            b"validators_changed"
+        ):
+            self._on_validators_changed(block, snap)
+
+    # -- steps --------------------------------------------------------------
+
+    def _storage(self, snap: Snapshot, contract: bytes, key: bytes):
+        return snap.get("storage", contract + key)
+
+    def _on_lottery_done(self, block: Block, snap: Snapshot) -> None:
+        raw = self._storage(snap, sc.STAKING_ADDRESS, b"next_validators")
+        if raw is None:
+            return
+        participants = Reader(raw).bytes_list()
+        if self.public_key not in participants:
+            self.keygen = None
+            return
+        cycle = block.header.index // self._cycle_duration
+        if self._cycle == cycle and self.keygen is not None:
+            return  # already running
+        self._cycle = cycle
+        self._participants = participants
+        self._addr_to_idx = {
+            ecdsa.address_from_public_key(pk): i
+            for i, pk in enumerate(participants)
+        }
+        n = len(participants)
+        f = (n - 1) // 3
+        kwargs = {"rng": self._rng} if self._rng is not None else {}
+        self.keygen = TrustlessKeygen(
+            self._priv, participants, f, cycle, **kwargs
+        )
+        self._keyring = None
+        commit = self.keygen.start_keygen()
+        logger.info("elected for cycle %d: sending keygen commit", cycle)
+        self._send_tx(
+            sc.GOVERNANCE_ADDRESS,
+            sc.SEL_KEYGEN_COMMIT + write_bytes(commit.to_bytes()),
+        )
+
+    def _on_commit(self, sender_addr: bytes, blob: bytes) -> None:
+        if self.keygen is None:
+            return
+        dealer = self._addr_to_idx.get(sender_addr)
+        if dealer is None:
+            return
+        try:
+            vmsg = self.keygen.handle_commit(dealer, CommitMessage.from_bytes(blob))
+        except ValueError:
+            logger.warning("faulty commit from dealer %d ignored", dealer)
+            return
+        self._send_tx(
+            sc.GOVERNANCE_ADDRESS,
+            sc.SEL_KEYGEN_SEND_VALUE
+            + write_u256(dealer)
+            + write_bytes(vmsg.to_bytes()),
+        )
+
+    def _on_value(self, sender_addr: bytes, blob: bytes) -> None:
+        if self.keygen is None:
+            return
+        sender = self._addr_to_idx.get(sender_addr)
+        if sender is None:
+            return
+        try:
+            should_confirm = self.keygen.handle_send_value(
+                sender, ValueMessage.from_bytes(blob)
+            )
+        except ValueError:
+            logger.warning("faulty value from sender %d ignored", sender)
+            return
+        if not should_confirm:
+            return
+        keyring = self.keygen.try_get_keys()
+        if keyring is None:
+            return
+        self._keyring = keyring
+        pub = keyring.public_keys(self.keygen.f, self._participants)
+        self._send_tx(
+            sc.GOVERNANCE_ADDRESS,
+            sc.SEL_KEYGEN_CONFIRM + write_bytes(pub.encode()),
+        )
+
+    def _on_validators_changed(self, block: Block, snap: Snapshot) -> None:
+        if self._keyring is None or self._cycle is None:
+            return
+        if self._cycle in self._installed_cycles:
+            return
+        self._installed_cycles.add(self._cycle)
+        first_era = (self._cycle + 1) * self._cycle_duration
+        logger.info("keygen finished: keys installed from era %d", first_era)
+        if self._on_keys is not None:
+            self._on_keys(first_era, self._keyring, list(self._participants))

@@ -28,6 +28,7 @@ from ..storage.state import StateManager
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
 from .execution import TransactionExecuter, get_nonce
+from .synchronizer import BlockSynchronizer
 from .tx_pool import TransactionPool
 from .types import Block, SignedTransaction
 
@@ -74,6 +75,9 @@ class Node:
         self.network.on_consensus = self._on_consensus
         self.network.on_sync_pool_reply = self._on_pool_txs
         self.network.on_ping_request = self._on_ping_request
+        self.synchronizer = BlockSynchronizer(
+            self.block_manager, self.pool, self.network, public_keys
+        )
         # validator index <-> transport identity
         self._pub_by_index: Dict[int, bytes] = {
             i: pk for i, pk in enumerate(public_keys.ecdsa_pub_keys)
@@ -91,10 +95,14 @@ class Node:
         await self.network.start()
         # the router exists before the era loop runs so consensus traffic
         # from faster peers is dispatched (or era-buffered), not dropped
-        self._ensure_router(first_era)
+        # (observers — index < 0 — only sync, never vote)
+        if self.index >= 0:
+            self._ensure_router(first_era)
+        self.synchronizer.start()
 
     async def stop(self) -> None:
         self._stopping = True
+        await self.synchronizer.stop()
         await self.network.stop()
 
     @property

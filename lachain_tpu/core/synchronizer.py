@@ -1,0 +1,210 @@
+"""Block synchronizer: follow-the-chain sync + multisig quorum verification.
+
+Parity with the reference's sync path
+(/root/reference/src/Lachain.Core/Network/BlockSynchronizer.cs:28-236:
+PingWorker tracks peer heights, BlockSyncWorker requests block ranges from
+the best peer, each block's validator multisig is quorum-checked and then
+executed through the exact producer commit path) and MultisigVerifier
+(Blockchain/Operations/MultisigVerifier.cs:1-67).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..consensus.keys import PublicConsensusKeys
+from ..crypto import ecdsa
+from ..network import wire
+from ..network.manager import NetworkManager
+from .block_manager import BlockManager
+from .tx_pool import TransactionPool
+from .types import Block, MultiSig, SignedTransaction
+
+logger = logging.getLogger(__name__)
+
+MAX_BLOCKS_PER_REQUEST = 32
+
+
+def verify_block_multisig(
+    block: Block, public_keys: PublicConsensusKeys
+) -> bool:
+    """N-F distinct valid validator signatures over the header hash
+    (reference MultisigVerifier.cs:1-67)."""
+    header_hash = block.header.hash()
+    seen = set()
+    valid = 0
+    for idx, sig in block.multisig.signatures:
+        if idx in seen or not 0 <= idx < public_keys.n:
+            continue
+        seen.add(idx)
+        pub = public_keys.ecdsa_pub_keys[idx]
+        if ecdsa.verify_hash(pub, header_hash, sig):
+            valid += 1
+    return valid >= public_keys.n - public_keys.f
+
+
+class BlockSynchronizer:
+    """Keeps a node's chain caught up with its peers."""
+
+    def __init__(
+        self,
+        block_manager: BlockManager,
+        pool: TransactionPool,
+        network: NetworkManager,
+        public_keys: PublicConsensusKeys,
+        *,
+        ping_interval: float = 1.0,
+    ):
+        self.bm = block_manager
+        self.pool = pool
+        self.network = network
+        self.public_keys = public_keys
+        self.ping_interval = ping_interval
+        self.peer_heights: Dict[bytes, int] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+        self._new_block = asyncio.Event()
+        self._request_inflight = False
+        # wire handlers (the serving side lives here too)
+        network.on_ping_reply = self._on_ping_reply
+        network.on_sync_blocks_request = self._on_blocks_request
+        network.on_sync_blocks_reply = self._on_blocks_reply
+        network.on_sync_pool_request = self._on_pool_request
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [loop.create_task(self._ping_loop())]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    async def _ping_loop(self) -> None:
+        while not self._stopped:
+            self.network.broadcast(
+                wire.ping_request(self.bm.current_height())
+            )
+            self._maybe_request()
+            await asyncio.sleep(self.ping_interval)
+
+    # -- peer state --------------------------------------------------------
+
+    def _on_ping_reply(self, sender: bytes, height: int) -> None:
+        self.peer_heights[sender] = height
+        self._maybe_request()
+
+    def _best_peer(self) -> Optional[Tuple[bytes, int]]:
+        if not self.peer_heights:
+            return None
+        pub, h = max(self.peer_heights.items(), key=lambda kv: kv[1])
+        return (pub, h)
+
+    def _maybe_request(self) -> None:
+        if self._request_inflight:
+            return
+        best = self._best_peer()
+        if best is None:
+            return
+        pub, their = best
+        mine = self.bm.current_height()
+        if their <= mine:
+            return
+        count = min(their - mine, MAX_BLOCKS_PER_REQUEST)
+        self._request_inflight = True
+        self.network.send_to(pub, wire.sync_blocks_request(mine + 1, count))
+
+    # -- serving -----------------------------------------------------------
+
+    def _on_blocks_request(self, sender: bytes, start: int, count: int) -> None:
+        count = min(count, MAX_BLOCKS_PER_REQUEST)
+        out: List[Tuple[Block, List[SignedTransaction]]] = []
+        for height in range(start, start + count):
+            block = self.bm.block_by_height(height)
+            if block is None:
+                break
+            txs = []
+            missing = False
+            for h in block.tx_hashes:
+                stx = self.bm.transaction_by_hash(h)
+                if stx is None:
+                    missing = True
+                    break
+                txs.append(stx)
+            if missing:
+                break
+            out.append((block, txs))
+        if out:
+            self.network.send_to(sender, wire.sync_blocks_reply(out))
+
+    def _on_pool_request(self, sender: bytes, hashes: List[bytes]) -> None:
+        txs = [stx for h in hashes if (stx := self.pool.get(h)) is not None]
+        if txs:
+            self.network.send_to(sender, wire.sync_pool_reply(txs))
+
+    # -- applying ----------------------------------------------------------
+
+    def _on_blocks_reply(
+        self, sender: bytes, blocks: List[Tuple[Block, List[SignedTransaction]]]
+    ) -> None:
+        self._request_inflight = False
+        applied = 0
+        for block, txs in blocks:
+            if self.handle_block(block, txs):
+                applied += 1
+            else:
+                break
+        if applied:
+            self._new_block.set()
+        self._maybe_request()
+
+    def handle_block(
+        self, block: Block, txs: List[SignedTransaction]
+    ) -> bool:
+        """Verify + execute one synced block at the current tip
+        (reference HandleBlockFromPeer, BlockSynchronizer.cs:110-180)."""
+        mine = self.bm.current_height()
+        if block.header.index <= mine:
+            return True  # already have it
+        if block.header.index != mine + 1:
+            return False  # gap; re-request from tip
+        prev = self.bm.block_by_height(mine)
+        if prev is not None and block.header.prev_block_hash != prev.hash():
+            logger.warning("synced block %d does not link", block.header.index)
+            return False
+        if not verify_block_multisig(block, self.public_keys):
+            logger.warning(
+                "synced block %d lacks a signature quorum", block.header.index
+            )
+            return False
+        if {t.hash() for t in txs} != set(block.tx_hashes):
+            logger.warning("synced block %d tx set mismatch", block.header.index)
+            return False
+        try:
+            self.bm.execute_block(
+                block.header, txs, block.multisig, check_state_hash=True
+            )
+        except ValueError:
+            logger.exception("synced block %d failed execution", block.header.index)
+            return False
+        self.pool.remove_included(block.tx_hashes)
+        return True
+
+    async def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
+        """Block until the local chain reaches `height`."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while self.bm.current_height() < height:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"sync stalled at {self.bm.current_height()} < {height}"
+                )
+            self._new_block.clear()
+            try:
+                await asyncio.wait_for(self._new_block.wait(), min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                pass

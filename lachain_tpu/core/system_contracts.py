@@ -37,6 +37,21 @@ VRF_SUBMISSION_PHASE = 500  # blocks of the cycle accepting VRF submissions
 ATTENDANCE_DETECTION_DURATION = 100
 
 
+def set_cycle_params(
+    cycle_duration: int,
+    vrf_submission_phase: int,
+    attendance_detection: int = ATTENDANCE_DETECTION_DURATION,
+) -> None:
+    """Initialize cycle constants from config (reference
+    StakingContract.Initialize, StakingContract.cs:186-197). Must be set
+    identically on every node before the chain starts."""
+    global CYCLE_DURATION, VRF_SUBMISSION_PHASE, ATTENDANCE_DETECTION_DURATION
+    assert 0 < vrf_submission_phase < cycle_duration
+    CYCLE_DURATION = cycle_duration
+    VRF_SUBMISSION_PHASE = vrf_submission_phase
+    ATTENDANCE_DETECTION_DURATION = attendance_detection
+
+
 def selector(signature: str) -> bytes:
     return keccak256(signature.encode())[:4]
 
@@ -356,6 +371,16 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
         ctx.sput(GOVERNANCE_ADDRESS, cnt_key, write_bytes_list(voters))
         ctx.sput(GOVERNANCE_ADDRESS, b"candidate:" + h, blob)
         ctx.emit(GOVERNANCE_ADDRESS, b"keygen_confirm" + ctx.sender)
+        # N-F matching confirms from the elected set finalize the rotation
+        # (reference GovernanceContract.Confirm -> ChangeValidators,
+        # GovernanceContract.cs:283-331)
+        nv_raw = ctx.sget(STAKING_ADDRESS, b"next_validators")
+        if nv_raw:
+            n_next = len(Reader(nv_raw).bytes_list())
+            f_next = (n_next - 1) // 3
+            if len(voters) >= n_next - f_next:
+                ctx.sput(GOVERNANCE_ADDRESS, b"pending_validators", blob)
+                ctx.emit(GOVERNANCE_ADDRESS, b"validators_changed" + h)
         return 1, write_u32(len(voters))
     if sel == SEL_CHANGE_VALIDATORS:
         blob = args.bytes_()
@@ -378,7 +403,13 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
 # ---------------------------------------------------------------------------
 
 
-def dispatch(snap: Snapshot, sender: bytes, tx: Transaction, block: int) -> Tuple[int, bytes]:
+def dispatch(
+    snap: Snapshot,
+    sender: bytes,
+    tx: Transaction,
+    block: int,
+    tx_hash: Optional[bytes] = None,
+) -> Tuple[int, bytes]:
     ctx = SystemContractContext(snap, sender, tx, block)
     data = tx.invocation
     if len(data) < 4:
@@ -386,16 +417,26 @@ def dispatch(snap: Snapshot, sender: bytes, tx: Transaction, block: int) -> Tupl
     sel, rest = data[:4], Reader(data[4:])
     try:
         if tx.to == DEPLOY_ADDRESS and sel == SEL_DEPLOY:
-            return deploy_contract(ctx, rest)
-        if tx.to == NATIVE_TOKEN_ADDRESS:
-            return native_token(ctx, sel, rest)
-        if tx.to == STAKING_ADDRESS:
-            return staking(ctx, sel, rest)
-        if tx.to == GOVERNANCE_ADDRESS:
-            return governance(ctx, sel, rest)
+            result = deploy_contract(ctx, rest)
+        elif tx.to == NATIVE_TOKEN_ADDRESS:
+            result = native_token(ctx, sel, rest)
+        elif tx.to == STAKING_ADDRESS:
+            result = staking(ctx, sel, rest)
+        elif tx.to == GOVERNANCE_ADDRESS:
+            result = governance(ctx, sel, rest)
+        else:
+            return 0, b""
     except (ValueError, AssertionError):
         return 0, b""
-    return 0, b""
+    # persist emitted events so node services (KeyGenManager) can react to
+    # executed system txs (reference: BlockManager.OnSystemContractInvoked,
+    # BlockManager.cs:171-176, 547-560)
+    if result[0] == 1 and tx_hash is not None:
+        from ..utils.serialization import write_u32 as _u32
+
+        for i, (contract, payload) in enumerate(ctx.events):
+            snap.put("events", tx_hash + _u32(i), contract + payload)
+    return result
 
 
 SYSTEM_CONTRACTS: Dict[bytes, Callable] = {

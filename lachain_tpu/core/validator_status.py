@@ -1,0 +1,97 @@
+"""ValidatorStatusManager: the stake -> VRF -> submit loop.
+
+Parity with the reference's background thread
+(/root/reference/src/Lachain.Core/ValidatorStatus/ValidatorStatusManager.cs:
+104, 219-266, 343-360, 432-440): once the node's address holds stake, each
+cycle's VRF submission phase it evaluates the lottery (Vrf.Evaluate over
+seed||cycle, stake-weighted winner check) and submits a SubmitVrf tx; it
+also drives the two-phase stake-withdrawal flow. Event-driven here (hooked
+on block persistence) instead of a polling thread.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..crypto import ecdsa, vrf
+from ..storage.state import Snapshot
+from ..utils.serialization import Reader, write_bytes, write_u32, write_u64, write_u256
+from . import system_contracts as sc
+from .types import Block
+
+logger = logging.getLogger(__name__)
+
+
+class ValidatorStatusManager:
+    def __init__(
+        self,
+        ecdsa_priv: bytes,
+        send_tx: Callable[[bytes, bytes], None],
+        *,
+        cycle_duration: Optional[int] = None,
+        vrf_phase: Optional[int] = None,
+    ):
+        self._priv = ecdsa_priv
+        self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
+        self.address = ecdsa.address_from_public_key(self.public_key)
+        self._send_tx = send_tx
+        self._cycle_duration = cycle_duration or sc.CYCLE_DURATION
+        self._vrf_phase = vrf_phase or sc.VRF_SUBMISSION_PHASE
+        self._submitted_cycles: set = set()
+        self.withdraw_requested = False
+
+    def _storage(self, snap: Snapshot, key: bytes) -> Optional[bytes]:
+        return snap.get("storage", sc.STAKING_ADDRESS + key)
+
+    def stake_of(self, snap: Snapshot) -> int:
+        raw = self._storage(snap, b"stake:" + self.address)
+        return int.from_bytes(raw, "big") if raw else 0
+
+    # -- block hook ---------------------------------------------------------
+
+    def on_block_persisted(self, block: Block, snap: Snapshot) -> None:
+        height = block.header.index
+        cycle = height // self._cycle_duration
+        in_phase = height % self._cycle_duration < self._vrf_phase
+        if not in_phase or cycle in self._submitted_cycles:
+            return
+        stake = self.stake_of(snap)
+        if stake == 0:
+            return
+        total_raw = self._storage(snap, b"total")
+        total = int.from_bytes(total_raw, "big") if total_raw else 0
+        if total == 0:
+            return
+        seed = self._storage(snap, b"seed") or b"genesis-seed"
+        alpha = seed + write_u64(cycle)
+        proof, beta = vrf.evaluate(self._priv, alpha)
+        expected = int.from_bytes(
+            self._storage(snap, b"validators_count") or write_u32(7), "big"
+        )
+        if not vrf.is_winner(beta, stake, total, expected):
+            logger.debug("cycle %d: not a lottery winner", cycle)
+            self._submitted_cycles.add(cycle)
+            return
+        logger.info("cycle %d: winning VRF roll, submitting", cycle)
+        self._submitted_cycles.add(cycle)
+        self._send_tx(
+            sc.STAKING_ADDRESS,
+            sc.SEL_SUBMIT_VRF
+            + write_bytes(self.public_key)
+            + write_bytes(proof),
+        )
+
+    # -- stake lifecycle ----------------------------------------------------
+
+    def become_staker(self, amount: int) -> None:
+        self._send_tx(
+            sc.STAKING_ADDRESS,
+            sc.SEL_BECOME_STAKER + write_bytes(self.public_key) + write_u256(amount),
+        )
+
+    def request_withdrawal(self) -> None:
+        self.withdraw_requested = True
+        self._send_tx(sc.STAKING_ADDRESS, sc.SEL_REQUEST_WITHDRAW + b"")
+
+    def withdraw(self) -> None:
+        self._send_tx(sc.STAKING_ADDRESS, sc.SEL_WITHDRAW + b"")

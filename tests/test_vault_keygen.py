@@ -1,0 +1,231 @@
+"""Vault + on-chain DKG lifecycle tests.
+
+Mirrors the reference's governance/keygen event flow
+(test/Lachain.CoreTest/IntegrationTests/GovernanceEventsTests.cs and
+Vault/KeyGenManager.cs): stake -> VRF lottery -> trustless keygen riding
+governance transactions -> validator change -> usable threshold keys in the
+era-keyed wallet."""
+import random
+
+import pytest
+
+from lachain_tpu.core import execution, system_contracts as sc
+from lachain_tpu.core.block_manager import BlockManager
+from lachain_tpu.core.keygen_manager import KeyGenManager
+from lachain_tpu.core.types import Transaction, sign_transaction
+from lachain_tpu.core.validator_status import ValidatorStatusManager
+from lachain_tpu.core.vault import PrivateWallet
+from lachain_tpu.crypto import ecdsa, tpke
+from lachain_tpu.storage.kv import MemoryKV
+from lachain_tpu.storage.state import StateManager
+from lachain_tpu.utils.serialization import write_u64
+
+CHAIN = 225
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+# ---------------------------------------------------------------------------
+# wallet
+# ---------------------------------------------------------------------------
+
+
+def _keyring_fixture():
+    dealer = tpke.TpkeTrustedKeyGen(4, 1, rng=Rng(3))
+    from lachain_tpu.crypto import threshold_sig as ts
+
+    ts_dealer = ts.TsTrustedKeyGen(4, 1, rng=Rng(4))
+    return dealer, ts_dealer
+
+
+def test_wallet_era_predecessor_lookup(tmp_path):
+    dealer, ts_dealer = _keyring_fixture()
+    w = PrivateWallet(
+        path=str(tmp_path / "w.wallet"), password="pw",
+        ecdsa_priv=ecdsa.generate_private_key(Rng(1)),
+    )
+    assert not w.has_keys_for_era(5)
+    w.add_threshold_keys(10, dealer.private_key(0), ts_dealer.private_key_share(0))
+    w.add_threshold_keys(50, dealer.private_key(1), ts_dealer.private_key_share(1))
+    assert not w.has_keys_for_era(9)
+    tp, _ = w.threshold_keys_for_era(10)
+    assert tp.idx == 0
+    tp, _ = w.threshold_keys_for_era(49)
+    assert tp.idx == 0
+    tp, _ = w.threshold_keys_for_era(50)
+    assert tp.idx == 1
+    tp, _ = w.threshold_keys_for_era(10**9)
+    assert tp.idx == 1
+
+
+def test_wallet_save_load_roundtrip(tmp_path):
+    dealer, ts_dealer = _keyring_fixture()
+    path = str(tmp_path / "node.wallet")
+    w = PrivateWallet(path=path, password="hunter2",
+                      ecdsa_priv=ecdsa.generate_private_key(Rng(2)))
+    w.add_threshold_keys(7, dealer.private_key(2), ts_dealer.private_key_share(2))
+    back = PrivateWallet.load(path, password="hunter2")
+    assert back.ecdsa_priv == w.ecdsa_priv
+    tp, tss = back.threshold_keys_for_era(8)
+    assert tp.to_bytes() == dealer.private_key(2).to_bytes()
+    assert tss.to_bytes() == ts_dealer.private_key_share(2).to_bytes()
+    with pytest.raises(Exception):
+        PrivateWallet.load(path, password="wrong")
+
+
+# ---------------------------------------------------------------------------
+# full cycle: stake -> lottery -> DKG on-chain -> rotation
+# ---------------------------------------------------------------------------
+
+
+class ChainHarness:
+    """Single in-process chain; participants' managers react to each block
+    (stands in for N networked nodes all executing the same blocks)."""
+
+    def __init__(self, accounts, balances):
+        self.kv = MemoryKV()
+        self.state = StateManager(self.kv)
+        self.bm = BlockManager(self.kv, self.state, sc.make_executer(CHAIN))
+        self.bm.build_genesis(balances, CHAIN)
+        self.pending = []
+        self.nonces = {}
+
+    def send_tx_for(self, priv):
+        addr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(priv))
+
+        def send(to: bytes, invocation: bytes) -> None:
+            nonce = self.nonces.get(addr, 0)
+            self.nonces[addr] = nonce + 1
+            tx = Transaction(
+                to=to, value=0, nonce=nonce, gas_price=1,
+                gas_limit=10**9, invocation=invocation,
+            )
+            self.pending.append(sign_transaction(tx, priv, CHAIN))
+
+        return send
+
+    def produce_block(self):
+        from lachain_tpu.core.types import BlockHeader, MultiSig
+
+        txs = self.bm.order_transactions(self.pending, CHAIN)
+        self.pending = []
+        height = self.bm.current_height() + 1
+        em = self.bm.emulate(txs, height)
+        prev = self.bm.block_by_height(height - 1)
+        from lachain_tpu.core.types import tx_merkle_root
+
+        header = BlockHeader(
+            index=height,
+            prev_block_hash=prev.hash(),
+            merkle_root=tx_merkle_root([t.hash() for t in txs]),
+            state_hash=em.state_hash,
+            nonce=height,
+        )
+        block = self.bm.execute_block(header, txs, MultiSig(()))
+        return block
+
+
+@pytest.mark.slow
+def test_full_cycle_rotation_produces_working_keys():
+    sc.set_cycle_params(20, 10)
+    try:
+        n_part = 4
+        privs = [ecdsa.generate_private_key(Rng(100 + i)) for i in range(n_part)]
+        addrs = [
+            ecdsa.address_from_public_key(ecdsa.public_key_bytes(p))
+            for p in privs
+        ]
+        chain = ChainHarness(privs, {a: 10**24 for a in addrs})
+
+        installed = {}  # participant index -> (first_era, keyring, participants)
+
+        def on_keys_for(i):
+            def cb(first_era, keyring, participants):
+                installed[i] = (first_era, keyring, participants)
+
+            return cb
+
+        vsms = [
+            ValidatorStatusManager(privs[i], chain.send_tx_for(privs[i]))
+            for i in range(n_part)
+        ]
+        kgms = [
+            KeyGenManager(
+                privs[i],
+                chain.send_tx_for(privs[i]),
+                on_keys=on_keys_for(i),
+                rng=Rng(500 + i),
+            )
+            for i in range(n_part)
+        ]
+
+        def after_block(block):
+            snap = chain.state.new_snapshot()
+            for vsm in vsms:
+                vsm.on_block_persisted(block, snap)
+            for kgm in kgms:
+                kgm.on_block_persisted(block, snap)
+
+        # blocks 1-2: everyone stakes
+        for vsm in vsms:
+            vsm.become_staker(10**20)
+        after_block(chain.produce_block())
+        # blocks 2..9: VRF submissions fire in the submission phase
+        for _ in range(8):
+            after_block(chain.produce_block())
+        # check winners recorded
+        snap = chain.state.new_snapshot()
+        winners_raw = snap.get(
+            "storage", sc.STAKING_ADDRESS + b"winners:" + write_u64(0)
+        )
+        assert winners_raw is not None, "no VRF winners recorded"
+        # block 10+: submission phase over; close the lottery
+        while chain.bm.current_height() < 10:
+            after_block(chain.produce_block())
+        chain.send_tx_for(privs[0])(
+            sc.STAKING_ADDRESS, sc.SEL_FINISH_LOTTERY + b""
+        )
+        after_block(chain.produce_block())  # lottery_done -> commits queued
+        # let the DKG message rounds play out (commit -> value -> confirm)
+        for _ in range(6):
+            after_block(chain.produce_block())
+
+        assert installed, "no participant installed rotated keys"
+        eras = {v[0] for v in installed.values()}
+        assert eras == {20}, f"keys should activate at cycle boundary: {eras}"
+        # every elected participant derived the SAME public key set
+        pub_blobs = {
+            v[1]
+            .public_keys((len(v[2]) - 1) // 3, v[2])
+            .encode()
+            for v in installed.values()
+        }
+        assert len(pub_blobs) == 1, "rotated public key sets disagree"
+
+        # the rotated keys WORK: TPKE encrypt/decrypt/combine roundtrip
+        some = next(iter(installed.values()))
+        participants = some[2]
+        f_new = (len(participants) - 1) // 3
+        pub_keys = some[1].public_keys(f_new, participants)
+        msg = b"rotated-era-secret" + bytes(14)
+        ct = pub_keys.tpke_pub.encrypt(msg, share_id=0, rng=Rng(9))
+        decs = []
+        for idx, (first_era, keyring, _) in installed.items():
+            decs.append(keyring.tpke_priv.decrypt_share(ct, check=False))
+        got = pub_keys.tpke_pub.full_decrypt(ct, decs[: f_new + 1])
+        assert got == msg
+
+        # and land in the wallet with era-keyed lookup
+        w = PrivateWallet(ecdsa_priv=privs[0])
+        fe, kr, _ = some
+        w.add_threshold_keys(fe, kr.tpke_priv, kr.ts_share)
+        assert w.has_keys_for_era(25)
+        assert not w.has_keys_for_era(19)
+    finally:
+        sc.set_cycle_params(1000, 500)
