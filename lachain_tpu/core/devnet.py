@@ -21,6 +21,7 @@ from ..consensus.simulator import DeliveryMode, SimulatedNetwork
 from ..crypto import ecdsa
 from ..storage.kv import MemoryKV
 from ..storage.state import StateManager
+from . import system_contracts
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
 from .execution import TransactionExecuter, get_balance, get_nonce
@@ -68,7 +69,9 @@ class Devnet:
         for i in range(n):
             kv = MemoryKV()
             state = StateManager(kv)
-            executer = TransactionExecuter(chain_id)
+            # full system-contract registry (deploy/LRC-20/governance/staking)
+            # so the devnet exercises the same execution surface as a real node
+            executer = system_contracts.make_executer(chain_id)
             bm = BlockManager(kv, state, executer)
             bm.build_genesis(self.initial_balances, chain_id)
             pool = TransactionPool(

@@ -147,7 +147,10 @@ class TransactionExecuter:
                 input=tx.invocation,
                 gas_limit=max(0, tx.gas_limit - GAS_PER_TX),
             )
-            gas_total = GAS_PER_TX + res.gas_used
+            # never bill beyond the up-front-verified gas limit: the meter
+            # clamps spent to its limit, and this min() guards against any
+            # residual overshoot so the sender balance cannot go negative
+            gas_total = min(GAS_PER_TX + res.gas_used, tx.gas_limit)
             if res.status != 1:
                 snap.restore(cp)
                 set_nonce(snap, sender, tx.nonce + 1)

@@ -59,8 +59,12 @@ class Node:
         self.chain_id = chain_id
         self.kv = kv if kv is not None else MemoryKV()
         self.state = StateManager(self.kv)
+        from . import system_contracts
+
         self.block_manager = BlockManager(
-            self.kv, self.state, executer or TransactionExecuter(chain_id)
+            self.kv,
+            self.state,
+            executer or system_contracts.make_executer(chain_id),
         )
         self.block_manager.build_genesis(dict(initial_balances or {}), chain_id)
         self.pool = TransactionPool(

@@ -66,6 +66,22 @@ class BlockSynchronizer:
         self._stopped = False
         self._new_block = asyncio.Event()
         self._request_inflight = False
+        self._request_peer: Optional[bytes] = None
+        self._request_start = 0
+        self._request_time = 0.0
+        # an unanswered request is abandoned after this long so
+        # _maybe_request rotates to the next best peer instead of wedging
+        # forever (reference BlockSynchronizer re-polls; a single lost reply
+        # must not stall sync)
+        self.request_timeout = max(3.0, 4 * ping_interval)
+        # peers that timed out or served nothing useful are benched for a
+        # window; pings keep updating their height but _best_peer skips them.
+        # Without this, a ping-responsive but sync-useless top-height peer
+        # re-enters the height table ~1s after being dropped and throttles
+        # sync to one batch per timeout period (or, for an always-empty
+        # replier, spins an unthrottled request/empty-reply hot loop).
+        self.peer_cooldown = 4 * self.request_timeout
+        self._benched: Dict[bytes, float] = {}
         # wire handlers (the serving side lives here too)
         network.on_ping_reply = self._on_ping_reply
         network.on_sync_blocks_request = self._on_blocks_request
@@ -99,14 +115,31 @@ class BlockSynchronizer:
         self._maybe_request()
 
     def _best_peer(self) -> Optional[Tuple[bytes, int]]:
-        if not self.peer_heights:
+        now = asyncio.get_event_loop().time()
+        live = [
+            (pub, h)
+            for pub, h in self.peer_heights.items()
+            if self._benched.get(pub, 0.0) <= now
+        ]
+        if not live:
             return None
-        pub, h = max(self.peer_heights.items(), key=lambda kv: kv[1])
-        return (pub, h)
+        return max(live, key=lambda kv: kv[1])
+
+    def _bench_peer(self, pub: bytes) -> None:
+        self._benched[pub] = (
+            asyncio.get_event_loop().time() + self.peer_cooldown
+        )
 
     def _maybe_request(self) -> None:
         if self._request_inflight:
-            return
+            now = asyncio.get_event_loop().time()
+            if now - self._request_time < self.request_timeout:
+                return
+            # request timed out: bench the unresponsive peer and rotate
+            if self._request_peer is not None:
+                self._bench_peer(self._request_peer)
+            self._request_inflight = False
+            self._request_peer = None
         best = self._best_peer()
         if best is None:
             return
@@ -116,6 +149,9 @@ class BlockSynchronizer:
             return
         count = min(their - mine, MAX_BLOCKS_PER_REQUEST)
         self._request_inflight = True
+        self._request_peer = pub
+        self._request_start = mine + 1
+        self._request_time = asyncio.get_event_loop().time()
         self.network.send_to(pub, wire.sync_blocks_request(mine + 1, count))
 
     # -- serving -----------------------------------------------------------
@@ -138,8 +174,9 @@ class BlockSynchronizer:
             if missing:
                 break
             out.append((block, txs))
-        if out:
-            self.network.send_to(sender, wire.sync_blocks_reply(out))
+        # always reply, even with no blocks — the requester uses the reply to
+        # clear its inflight flag; silence would otherwise wedge its sync
+        self.network.send_to(sender, wire.sync_blocks_reply(out))
 
     def _on_pool_request(self, sender: bytes, hashes: List[bytes]) -> None:
         txs = [stx for h in hashes if (stx := self.pool.get(h)) is not None]
@@ -151,7 +188,8 @@ class BlockSynchronizer:
     def _on_blocks_reply(
         self, sender: bytes, blocks: List[Tuple[Block, List[SignedTransaction]]]
     ) -> None:
-        self._request_inflight = False
+        awaited = self._request_inflight and sender == self._request_peer
+        mine_before = self.bm.current_height()
         applied = 0
         for block, txs in blocks:
             if self.handle_block(block, txs):
@@ -160,6 +198,28 @@ class BlockSynchronizer:
                 break
         if applied:
             self._new_block.set()
+        if not awaited:
+            # stale or unsolicited reply: blocks above were still applied if
+            # valid, but it must not cancel a live request to another peer
+            # (that would spawn duplicate concurrent requests)
+            return
+        req_start = self._request_start
+        self._request_inflight = False
+        self._request_peer = None
+        if self.bm.current_height() > mine_before:
+            pass  # real progress
+        elif any(
+            req_start <= blk.header.index <= mine_before for blk, _ in blocks
+        ):
+            # we raced ahead of the request (our own consensus committed the
+            # blocks first); the peer honestly served what we asked for —
+            # benching it would starve sync of its best peers at the tip
+            pass
+        elif self.peer_heights.get(sender, 0) > mine_before:
+            # the peer advertises more blocks than us but served nothing
+            # usable (empty reply, gap, bad multisig, stale spam): bench it
+            # so the next request rotates instead of hot-looping against it
+            self._bench_peer(sender)
         self._maybe_request()
 
     def handle_block(

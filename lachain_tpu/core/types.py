@@ -125,6 +125,22 @@ class TransactionReceipt:
             + write_bytes(self.return_data)
         )
 
+    @classmethod
+    def decode(cls, data: bytes) -> "TransactionReceipt":
+        r = Reader(data)
+        tx_hash = r.raw(32)
+        block_index = r.u64()
+        index_in_block = r.u32()
+        gas_used = r.u64()
+        status = r.u32()
+        sender = r.raw(ADDRESS_BYTES)
+        return_data = r.bytes_()
+        r.assert_eof()
+        return cls(
+            tx_hash, block_index, index_in_block, gas_used, status,
+            sender, return_data,
+        )
+
 
 @dataclass(frozen=True)
 class BlockHeader:

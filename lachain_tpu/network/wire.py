@@ -290,8 +290,12 @@ class MessageBatch:
         )
 
     def messages(self) -> List[NetworkMessage]:
-        raw = zlib.decompress(self.content, bufsize=1 << 20)
-        if len(raw) > 1 << 26:
+        # decompress with a hard output cap: zlib.decompress's bufsize is only
+        # an initial buffer size, so a small compressed frame could otherwise
+        # expand to tens of GB before any size check runs (zip-bomb)
+        d = zlib.decompressobj()
+        raw = d.decompress(self.content, 1 << 26)
+        if d.unconsumed_tail or not d.eof:
             raise ValueError("batch too large")
         r = Reader(raw)
         out = []

@@ -9,6 +9,13 @@ replaces the engine's compiled-code injection).
 
 Values: i32/i64 are canonical unsigned Python ints; f32/f64 Python floats
 (f32 results rounded through single precision).
+
+Float determinism rule: every NaN entering the value domain (loads,
+reinterprets, f32 rounding) is canonicalized to the positive quiet NaN with
+zero payload, so NaN bit patterns observable by contracts are identical on
+every node regardless of host FP hardware. The reference relies on the .NET
+JIT's platform behavior here (VirtualMachine.cs:33-60); we make the rule
+explicit.
 """
 from __future__ import annotations
 
@@ -33,6 +40,16 @@ MASK32 = 0xFFFFFFFF
 MASK64 = 0xFFFFFFFFFFFFFFFF
 MAX_CALL_DEPTH = 512
 MAX_MEMORY_PAGES = 1024  # 64 MiB hard cap for contracts
+MAX_TABLE_SIZE = 65_536  # funcref table cap at instantiation
+
+# Gas schedule for interpreted execution. The reference meters compiled WASM
+# where one instruction is ~ns scale; this engine dispatches in Python at
+# ~1e6-1e7 ops/s, so a 1-gas/op schedule against a 1e11 block gas limit would
+# permit hours of CPU per block. 2_000 gas/op bounds a full block to ~5e7
+# interpreter steps (seconds — in line with the 5 s target block interval).
+INSTRUCTION_GAS = 2_000
+MEMORY_GROW_GAS_PER_PAGE = 1_000_000  # priced near storage, not near free
+BULK_MEMORY_GAS_PER_BYTE = 10
 
 
 class WasmTrap(Exception):
@@ -53,7 +70,11 @@ class GasMeter:
     def charge(self, amount: int) -> None:
         self.spent += amount
         if self.spent > self.limit:
-            raise OutOfGas(f"out of gas: {self.spent} > {self.limit}")
+            # clamp so callers can never observe (and bill) more gas than the
+            # tx's up-front-verified limit, even when a host import charges a
+            # large attacker-controlled amount in one step
+            self.spent = self.limit
+            raise OutOfGas(f"out of gas (limit {self.limit})")
 
     @property
     def remaining(self) -> int:
@@ -68,8 +89,22 @@ def _s64(v: int) -> int:
     return v - (1 << 64) if v & 0x8000000000000000 else v
 
 
+_CANON_NAN = struct.unpack("<d", b"\x00\x00\x00\x00\x00\x00\xf8\x7f")[0]
+
+
+def _canon(v: float) -> float:
+    """Consensus determinism rule: every NaN that enters the value domain is
+    replaced by the positive quiet NaN with zero payload. NaN payload
+    propagation through host FP hardware is platform-dependent; contracts
+    could otherwise observe differing bit patterns via reinterpret/store and
+    diverge the state hash across nodes."""
+    return _CANON_NAN if v != v else v
+
+
 def _f32(v: float) -> float:
-    """Round through single precision."""
+    """Round through single precision (canonicalizing NaNs)."""
+    if v != v:
+        return _CANON_NAN
     return struct.unpack("<f", struct.pack("<f", v))[0]
 
 
@@ -186,11 +221,15 @@ class Instance:
         self.table: List[Optional[int]] = []
         if module.tables:
             lo, hi = module.tables[0]
+            if lo > MAX_TABLE_SIZE:
+                raise WasmTrap("table too large")
             self.table = [None] * lo
         for seg in module.elements:
             off = self._eval_const(seg.offset_expr)
             if not isinstance(off, int):
                 raise WasmTrap("bad element offset")
+            if off + len(seg.func_indices) > MAX_TABLE_SIZE:
+                raise WasmTrap("element segment exceeds table cap")
             if off + len(seg.func_indices) > len(self.table):
                 self.table.extend(
                     [None] * (off + len(seg.func_indices) - len(self.table))
@@ -220,9 +259,9 @@ class Instance:
         if op == 0x42:
             return ins[1] & MASK64
         if op == 0x43:
-            return struct.unpack("<f", ins[1])[0]
+            return _canon(struct.unpack("<f", ins[1])[0])
         if op == 0x44:
-            return struct.unpack("<d", ins[1])[0]
+            return _canon(struct.unpack("<d", ins[1])[0])
         if op == 0x23:
             return self.globals[ins[1]]
         raise WasmTrap("unsupported init expression")
@@ -303,7 +342,7 @@ class Instance:
         while pc < n_body:
             ins = body[pc]
             op = ins[0]
-            charge(1)
+            charge(INSTRUCTION_GAS)
 
             # ---- control ----
             if op == 0x0B:  # end
@@ -419,9 +458,9 @@ class Instance:
                     elif op == 0x29:
                         stack.append(int.from_bytes(self._mem_read(addr, 8), "little"))
                     elif op == 0x2A:
-                        stack.append(struct.unpack("<f", self._mem_read(addr, 4))[0])
+                        stack.append(_canon(struct.unpack("<f", self._mem_read(addr, 4))[0]))
                     elif op == 0x2B:
-                        stack.append(struct.unpack("<d", self._mem_read(addr, 8))[0])
+                        stack.append(_canon(struct.unpack("<d", self._mem_read(addr, 8))[0]))
                     elif op == 0x2C:  # i32.load8_s
                         v = self._mem_read(addr, 1)[0]
                         stack.append((v - 256 if v & 0x80 else v) & MASK32)
@@ -481,7 +520,7 @@ class Instance:
                 if old + delta > self.mem_max:
                     stack.append(MASK32)  # -1
                 else:
-                    charge(256 * delta)  # growth is not free
+                    charge(MEMORY_GROW_GAS_PER_PAGE * delta)
                     self.mem_pages = old + delta
                     self.memory.extend(bytes(delta * PAGE_SIZE))
                     stack.append(old)
@@ -498,11 +537,11 @@ class Instance:
                 pc += 1
                 continue
             if op == 0x43:
-                stack.append(struct.unpack("<f", ins[1])[0])
+                stack.append(_canon(struct.unpack("<f", ins[1])[0]))
                 pc += 1
                 continue
             if op == 0x44:
-                stack.append(struct.unpack("<d", ins[1])[0])
+                stack.append(_canon(struct.unpack("<d", ins[1])[0]))
                 pc += 1
                 continue
 
@@ -769,9 +808,9 @@ class Instance:
         elif op == 0xBD:
             push(int.from_bytes(struct.pack("<d", pop()), "little"))
         elif op == 0xBE:
-            push(struct.unpack("<f", (pop() & MASK32).to_bytes(4, "little"))[0])
+            push(_canon(struct.unpack("<f", (pop() & MASK32).to_bytes(4, "little"))[0]))
         elif op == 0xBF:
-            push(struct.unpack("<d", (pop() & MASK64).to_bytes(8, "little"))[0])
+            push(_canon(struct.unpack("<d", (pop() & MASK64).to_bytes(8, "little"))[0]))
         elif op == 0xC0:  # i32.extend8_s
             v = pop() & 0xFF
             push((v - 256 if v & 0x80 else v) & MASK32)
@@ -807,12 +846,12 @@ class Instance:
                 push(_trunc_sat(pop(), 0, MASK64, 64))
             elif sub == 10:  # memory.copy
                 n, s, d = pop(), pop(), pop()
-                self.gas.charge(n // 8)
+                self.gas.charge(BULK_MEMORY_GAS_PER_BYTE * n)
                 data = self._mem_read(s, n)
                 self._mem_write(d, data)
             elif sub == 11:  # memory.fill
                 n, v, d = pop(), pop(), pop()
-                self.gas.charge(n // 8)
+                self.gas.charge(BULK_MEMORY_GAS_PER_BYTE * n)
                 self._mem_write(d, bytes([v & 0xFF]) * n)
             else:
                 raise WasmTrap(f"unsupported 0xfc:{sub}")
@@ -822,7 +861,10 @@ class Instance:
     def _float_op(self, rel: int, stack: List[object], single: bool) -> None:
         push = stack.append
         pop = stack.pop
-        rnd = _f32 if single else (lambda x: x)
+        # _canon for f64: arithmetic on doubles must never expose the host
+        # FPU's NaN (x86 produces a negative qNaN for inf-inf; ARM a positive
+        # one) — all results funnel through the canonical quiet NaN
+        rnd = _f32 if single else _canon
         if rel == 0:
             push(rnd(abs(pop())))
         elif rel == 1:
@@ -856,7 +898,12 @@ class Instance:
         elif rel == 10:
             b, a = pop(), pop()
             if b == 0:
-                push(float("nan") if a == 0 else math.copysign(float("inf"), a) * math.copysign(1.0, b))
+                # 0/0 and NaN/0 are NaN; finite/0 is signed infinity
+                push(
+                    float("nan")
+                    if a == 0 or a != a
+                    else math.copysign(float("inf"), a) * math.copysign(1.0, b)
+                )
             else:
                 push(rnd(a / b))
         elif rel == 11:

@@ -365,7 +365,10 @@ def decode_module(data: bytes) -> Module:
                     vt = fr.byte()
                     if vt not in VALTYPES:
                         raise WasmDecodeError("bad local type")
-                    if cnt > 1_000_000:
+                    # total cap per function, not per declaration group — a
+                    # tiny module can otherwise declare ~10^11 locals via
+                    # repeated groups and exhaust memory at decode time
+                    if cnt + len(locals_) > 50_000:
                         raise WasmDecodeError("too many locals")
                     locals_.extend([vt] * cnt)
                 fn = Function(m.func_type_indices[i], locals_, _decode_expr(fr))

@@ -256,3 +256,25 @@ def test_four_node_consensus_over_tcp():
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_zip_bomb_batch_rejected():
+    # a small compressed frame that expands past the 64 MiB cap must be
+    # rejected without ever materializing the full decompressed output
+    import zlib
+
+    from lachain_tpu.crypto import ecdsa as _ecdsa
+    from lachain_tpu.crypto.hashes import keccak256
+    from lachain_tpu.network.wire import MessageBatch
+
+    priv = _ecdsa.generate_private_key()
+    bomb = zlib.compress(b"\x00" * (1 << 28), level=9)  # 256 MiB -> ~256 KiB
+    assert len(bomb) < 1 << 20
+    batch = MessageBatch(
+        sender=_ecdsa.public_key_bytes(priv),
+        signature=_ecdsa.sign_hash(priv, keccak256(bomb)),
+        content=bomb,
+    )
+    assert batch.verify()
+    with pytest.raises(ValueError):
+        batch.messages()

@@ -137,3 +137,103 @@ def test_observer_syncs_chain_over_tcp():
             await node.stop()
 
     asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# peer-rotation hardening (round-2): timeouts, benching, stale replies
+# ---------------------------------------------------------------------------
+
+
+class _FakeBM:
+    def __init__(self):
+        self.h = 0
+
+    def current_height(self):
+        return self.h
+
+    def block_by_height(self, h):
+        return None
+
+    def transaction_by_hash(self, h):
+        return None
+
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []
+
+    def broadcast(self, msg):
+        pass
+
+    def send_to(self, pub, msg):
+        self.sent.append((pub, msg))
+
+
+def _make_sync():
+    from lachain_tpu.core.synchronizer import BlockSynchronizer
+
+    pub, _ = trusted_key_gen(4, 1, rng=Rng(3))
+    bm, net = _FakeBM(), _FakeNet()
+    return BlockSynchronizer(bm, None, net, pub, ping_interval=0.01), bm, net
+
+
+def test_sync_benches_peer_serving_empty_replies():
+    async def main():
+        s, bm, net = _make_sync()
+        s.peer_cooldown = 10.0
+        peer_a, peer_b = b"A" * 33, b"B" * 33
+        s._on_ping_reply(peer_a, 100)
+        assert net.sent[-1][0] == peer_a
+        s._on_ping_reply(peer_b, 50)
+        net.sent.clear()
+        # A advertises blocks but serves none: benched, rotate to B
+        s._on_blocks_reply(peer_a, [])
+        assert net.sent and net.sent[-1][0] == peer_b
+        # a late/unsolicited reply from A must not cancel the live B request
+        n_before = len(net.sent)
+        s._on_blocks_reply(peer_a, [])
+        assert len(net.sent) == n_before
+        # even a fresh ping from A while benched must not pick it again
+        net.sent.clear()
+        s._on_blocks_reply(peer_b, [])
+        assert all(dst != peer_a for dst, _ in net.sent)
+
+    asyncio.run(main())
+
+
+def test_sync_request_timeout_rotates_to_next_peer():
+    async def main():
+        s, bm, net = _make_sync()
+        s.request_timeout = 0.03
+        s.peer_cooldown = 10.0
+        peer_a, peer_b = b"A" * 33, b"B" * 33
+        s._on_ping_reply(peer_a, 100)
+        s._on_ping_reply(peer_b, 50)
+        assert net.sent[-1][0] == peer_a
+        await asyncio.sleep(0.05)
+        net.sent.clear()
+        s._maybe_request()
+        assert net.sent and net.sent[-1][0] == peer_b
+
+    asyncio.run(main())
+
+
+def test_sync_does_not_bench_peer_after_tip_race():
+    async def main():
+        s, bm, net = _make_sync()
+        s.peer_cooldown = 10.0
+        peer_a = b"A" * 33
+        s._on_ping_reply(peer_a, 1)  # request for block 1 goes out
+        assert net.sent[-1][0] == peer_a
+        # our own consensus commits block 1 before the reply arrives
+        bm.h = 1
+
+        class _Blk:
+            class header:
+                index = 1
+
+        s._on_blocks_reply(peer_a, [(_Blk, [])])
+        # peer served exactly what we asked for: must NOT be benched
+        assert s._benched.get(peer_a, 0.0) == 0.0
+
+    asyncio.run(main())

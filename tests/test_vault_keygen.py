@@ -55,13 +55,13 @@ def test_wallet_era_predecessor_lookup(tmp_path):
     w.add_threshold_keys(50, dealer.private_key(1), ts_dealer.private_key_share(1))
     assert not w.has_keys_for_era(9)
     tp, _ = w.threshold_keys_for_era(10)
-    assert tp.idx == 0
+    assert tp.my_id == 0
     tp, _ = w.threshold_keys_for_era(49)
-    assert tp.idx == 0
+    assert tp.my_id == 0
     tp, _ = w.threshold_keys_for_era(50)
-    assert tp.idx == 1
+    assert tp.my_id == 1
     tp, _ = w.threshold_keys_for_era(10**9)
-    assert tp.idx == 1
+    assert tp.my_id == 1
 
 
 def test_wallet_save_load_roundtrip(tmp_path):

@@ -577,3 +577,91 @@ def test_nested_gas_cap_does_not_poison_parent():
     )
     assert res.status == 1
     assert int.from_bytes(res.return_data, "little") == 42
+
+
+# ---------------------------------------------------------------------------
+# hardening regressions (round-2 advisor findings)
+# ---------------------------------------------------------------------------
+
+
+def test_gas_meter_clamps_spent_to_limit():
+    g = GasMeter(1000)
+    g.charge(900)
+    with pytest.raises(OutOfGas):
+        g.charge(10**12)  # huge host-call charge must not overshoot
+    assert g.spent == 1000
+
+
+def test_locals_total_cap_is_per_function_not_per_group():
+    # many declaration groups that individually pass a per-group cap but
+    # together would allocate unbounded memory at decode time
+    from lachain_tpu.vm.builder import uleb
+    from lachain_tpu.vm.wasm import WasmDecodeError
+
+    b = ModuleBuilder()
+    b.add_function([], [], [], [Op.end], export="f")
+    raw = bytearray(b.build())
+    # hand-craft a code section with 200 groups x 40_000 i32 locals
+    groups = 200
+    body = uleb(groups) + (uleb(40_000) + bytes([0x7F])) * groups + b"\x0b"
+    func = uleb(len(body)) + body
+    code_sec = uleb(1) + func
+    # rebuild: replace the code section (id 10)
+    i = 8
+    out = bytearray(raw[:8])
+    while i < len(raw):
+        sec_id = raw[i]
+        j = i + 1
+        size = 0
+        shift = 0
+        while True:
+            byte = raw[j]
+            j += 1
+            size |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                break
+        if sec_id == 10:
+            out.append(10)
+            out.extend(uleb(len(code_sec)))
+            out.extend(code_sec)
+        else:
+            out.extend(raw[i:j + size])
+        i = j + size
+    with pytest.raises(WasmDecodeError):
+        decode_module(bytes(out))
+
+
+def test_element_segment_table_cap():
+    from lachain_tpu.vm.interpreter import MAX_TABLE_SIZE
+    from lachain_tpu.vm.wasm import ElementSegment
+
+    b = ModuleBuilder()
+    b.add_function([], [I32], [], [Op.i32_const(7)], export="f")
+    m = decode_module(b.build())
+    m.tables = [(1, None)]
+    # element-segment offset far beyond the cap would force a ~GB-scale
+    # table allocation during instantiation
+    m.elements = [ElementSegment(0, [(0x41, MAX_TABLE_SIZE + 5), (0x0B,)], [0])]
+    with pytest.raises(WasmTrap):
+        Instance(m)
+
+
+def test_float_nan_canonicalization():
+    # storing attacker-chosen NaN payload bits, loading as f32, and
+    # reinterpreting back must observe the canonical quiet NaN on every node
+    b = ModuleBuilder()
+    b.add_memory(1)
+    body = [
+        # store a signaling-NaN bit pattern with a payload
+        Op.i32_const(0),
+        Op.i32_const(0x7FA0BEEF - (1 << 32)),
+        Op.i32_store(),
+        # load as f32, reinterpret to i32
+        Op.i32_const(0),
+        bytes([0x2A, 0x02, 0x00]),  # f32.load
+        bytes([0xBC]),  # i32.reinterpret_f32
+    ]
+    b.add_function([], [I32], [], body, export="f")
+    inst = instantiate(b)
+    assert inst.invoke("f", []) == 0x7FC00000  # canonical quiet NaN
