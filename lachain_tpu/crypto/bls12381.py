@@ -691,10 +691,16 @@ def _final_exp_hard(m):
 
 
 def final_exponentiation(f):
+    """f^((p^6-1)(p^2+1) * 3h) with h = (p^4-p^2+1)/r — the framework's GT
+    convention is the CUBED ate pairing, matching the
+    Hayashida-Hayasaka-Teruya addition chain the native backend uses
+    (e^3 is bilinear and, since gcd(3, r) = 1, equality checks are
+    unchanged; GT values are never serialized on the wire)."""
     # easy part: f^((p^6-1)(p^2+1))
     t = fp12_mul(fp12_conj(f), fp12_inv(f))  # f^(p^6-1)
     t = fp12_mul(fp12_frobenius_n(t, 2), t)  # ^(p^2+1)
-    return _final_exp_hard(t)
+    out = _final_exp_hard(t)
+    return fp12_mul(fp12_mul(out, out), out)  # ^3
 
 
 def pairing(p1, q2):

@@ -521,6 +521,20 @@ static void fp12_mul(Fp12 &z, const Fp12 &a, const Fp12 &b) {
   z.c1 = c1;
 }
 static inline void fp12_sqr(Fp12 &z, const Fp12 &a) { fp12_mul(z, a, a); }
+
+// complex squaring for Fp12 = Fp6[w]/(w^2 - v): 2 fp6_mul instead of 3
+static void fp12_sqr_fast(Fp12 &z, const Fp12 &a) {
+  Fp6 t, s0, s1, vt;
+  fp6_mul(t, a.c0, a.c1);
+  fp6_add(s0, a.c0, a.c1);
+  fp6_mul_by_v(vt, a.c1);
+  fp6_add(s1, a.c0, vt);
+  fp6_mul(s1, s0, s1);  // (a0+a1)(a0+v a1) = a0^2 + v a1^2 + (1+v) a0 a1
+  fp6_sub(s1, s1, t);
+  fp6_mul_by_v(vt, t);
+  fp6_sub(z.c0, s1, vt);
+  fp6_add(z.c1, t, t);
+}
 static inline void fp12_conj(Fp12 &z, const Fp12 &a) {
   z.c0 = a.c0;
   fp6_neg(z.c1, a.c1);
@@ -927,137 +941,205 @@ static bool g2_in_subgroup(const G2 &p) {
 // Pairing — same structure as the oracle: affine Miller loop on E(Fp12).
 // ===========================================================================
 
-struct E12 {  // affine point on E(Fp12); inf flag
-  Fp12 x, y;
-  bool inf;
-};
-
-static Fp12 W2_INV, W3_INV;  // 1/w^2, 1/w^3
-static Fp12 FP12_THREE, FP12_TWO;
-
-static void fp12_from_fp2(Fp12 &z, const Fp2 &a) {
-  z = FP12_ZERO_;
-  z.c0.c0 = a;
-}
-
-static void e12_untwist(E12 &r, const Fp2 &qx, const Fp2 &qy) {
-  Fp12 x12, y12;
-  fp12_from_fp2(x12, qx);
-  fp12_from_fp2(y12, qy);
-  fp12_mul(r.x, x12, W2_INV);
-  fp12_mul(r.y, y12, W3_INV);
-  r.inf = false;
-}
-
-static void e12_add(E12 &r, const E12 &p, const E12 &q) {
-  if (p.inf) {
-    r = q;
-    return;
-  }
-  if (q.inf) {
-    r = p;
-    return;
-  }
-  Fp12 lam;
-  if (fp12_eq(p.x, q.x)) {
-    if (fp12_eq(p.y, q.y)) {
-      if (fp12_is_zero(p.y)) {
-        r.inf = true;
-        return;
-      }
-      Fp12 num, den, deninv;
-      fp12_sqr(num, p.x);
-      fp12_mul(num, num, FP12_THREE);
-      fp12_mul(den, p.y, FP12_TWO);
-      fp12_inv(deninv, den);
-      fp12_mul(lam, num, deninv);
-    } else {
-      r.inf = true;
-      return;
-    }
-  } else {
-    Fp12 num, den, deninv;
-    fp12_sub(num, q.y, p.y);
-    fp12_sub(den, q.x, p.x);
-    fp12_inv(deninv, den);
-    fp12_mul(lam, num, deninv);
-  }
-  Fp12 x3, y3, t;
-  fp12_sqr(x3, lam);
-  fp12_sub(x3, x3, p.x);
-  fp12_sub(x3, x3, q.x);
-  fp12_sub(t, p.x, x3);
-  fp12_mul(y3, lam, t);
-  fp12_sub(y3, y3, p.y);
-  r.x = x3;
-  r.y = y3;
-  r.inf = false;
-}
-
-// line through t and q evaluated at P (px, py in Fp embedded in Fp12)
-static void line_eval(Fp12 &out, const E12 &t, const E12 &q, const Fp12 &px12,
-                      const Fp12 &py12) {
-  bool same = fp12_eq(t.x, q.x) && fp12_eq(t.y, q.y);
-  if (!same && fp12_eq(t.x, q.x)) {
-    fp12_sub(out, px12, t.x);
-    return;
-  }
-  Fp12 lam;
-  if (same) {
-    if (fp12_is_zero(t.y)) {
-      fp12_sub(out, px12, t.x);
-      return;
-    }
-    Fp12 num, den, deninv;
-    fp12_sqr(num, t.x);
-    fp12_mul(num, num, FP12_THREE);
-    fp12_mul(den, t.y, FP12_TWO);
-    fp12_inv(deninv, den);
-    fp12_mul(lam, num, deninv);
-  } else {
-    Fp12 num, den, deninv;
-    fp12_sub(num, q.y, t.y);
-    fp12_sub(den, q.x, t.x);
-    fp12_inv(deninv, den);
-    fp12_mul(lam, num, deninv);
-  }
-  Fp12 t1, t2;
-  fp12_sub(t1, py12, t.y);
-  fp12_sub(t2, px12, t.x);
-  fp12_mul(t2, lam, t2);
-  fp12_sub(out, t1, t2);
-}
-
 static const u64 ATE_LOOP = 0xd201000000010000ull;  // |X_PARAM|
 
+// --- fast Miller loop: affine coordinates ON THE TWIST (Fp2 slopes, one
+// cheap Fp2 inversion per step) with sparse line multiplication. Each line
+// is scaled by v*w, which is killed by the final exponentiation
+// ((vw)^2 = xi in Fp2, so (vw)^(p^6-1) has order <= 2 and dies under
+// (p^2+1)*hard). Replaces the reference-shaped affine-E(Fp12) loop whose
+// per-step Fp12 inversions made a pairing ~15 ms.
+
+// f *= (A + B*v) + (C*v)*w   [slots c0.c0 = A, c0.c1 = B, c1.c1 = C]
+static void fp12_mul_sparse(Fp12 &f, const Fp2 &A, const Fp2 &B,
+                            const Fp2 &C) {
+  const Fp6 &a = f.c0, &b = f.c1;
+  Fp6 r0, r1;
+  Fp2 t;
+  // a * (A + Bv): (a0*A + xi*a2*B, a1*A + a0*B, a2*A + a1*B)
+  Fp2 a0A, a1A, a2A, a0B, a1B, a2B;
+  fp2_mul(a0A, a.c0, A);
+  fp2_mul(a1A, a.c1, A);
+  fp2_mul(a2A, a.c2, A);
+  fp2_mul(a0B, a.c0, B);
+  fp2_mul(a1B, a.c1, B);
+  fp2_mul(a2B, a.c2, B);
+  fp2_mul_xi(t, a2B);
+  fp2_add(r0.c0, a0A, t);
+  fp2_add(r0.c1, a1A, a0B);
+  fp2_add(r0.c2, a2A, a1B);
+  // + v * (b * Cv) = b*C*v^2 = (xi*b1C, xi*b2C, b0C)
+  Fp2 b0C, b1C, b2C;
+  fp2_mul(b0C, b.c0, C);
+  fp2_mul(b1C, b.c1, C);
+  fp2_mul(b2C, b.c2, C);
+  fp2_mul_xi(t, b1C);
+  fp2_add(r0.c0, r0.c0, t);
+  fp2_mul_xi(t, b2C);
+  fp2_add(r0.c1, r0.c1, t);
+  fp2_add(r0.c2, r0.c2, b0C);
+  // c1' = a*(Cv) + b*(A + Bv)
+  // a*Cv = (xi*a2C, a0C, a1C)
+  Fp2 a0C, a1C, a2C;
+  fp2_mul(a0C, a.c0, C);
+  fp2_mul(a1C, a.c1, C);
+  fp2_mul(a2C, a.c2, C);
+  fp2_mul_xi(t, a2C);
+  r1.c0 = t;
+  r1.c1 = a0C;
+  r1.c2 = a1C;
+  Fp2 b0A, b1A, b2A, b0B, b1B, b2B;
+  fp2_mul(b0A, b.c0, A);
+  fp2_mul(b1A, b.c1, A);
+  fp2_mul(b2A, b.c2, A);
+  fp2_mul(b0B, b.c0, B);
+  fp2_mul(b1B, b.c1, B);
+  fp2_mul(b2B, b.c2, B);
+  fp2_mul_xi(t, b2B);
+  fp2_add(r1.c0, r1.c0, b0A);
+  fp2_add(r1.c0, r1.c0, t);
+  fp2_add(r1.c1, r1.c1, b1A);
+  fp2_add(r1.c1, r1.c1, b0B);
+  fp2_add(r1.c2, r1.c2, b2A);
+  fp2_add(r1.c2, r1.c2, b1B);
+  f.c0 = r0;
+  f.c1 = r1;
+}
+
 static void miller_loop(Fp12 &f, const G1 &p, const G2 &q) {
+  // Homogeneous-projective twist coordinates: ZERO field inversions in the
+  // loop (the affine variant spent ~10us/step in fp_inv). Lines are scaled
+  // by per-step Fp2 factors, which the final exponentiation kills.
   if (g1_is_inf(p) || g2_is_inf(q)) {
     f = FP12_ONE_;
     return;
   }
-  Fp pax, pay;
-  g1_to_affine(pax, pay, p);
-  Fp2 qax, qay;
-  g2_to_affine(qax, qay, q);
-  Fp12 px12 = FP12_ZERO_, py12 = FP12_ZERO_;
-  px12.c0.c0.c0 = pax;
-  py12.c0.c0.c0 = pay;
-  E12 Q, T;
-  e12_untwist(Q, qax, qay);
-  T = Q;
+  Fp px, py;
+  g1_to_affine(px, py, p);
+  Fp2 xQ, yQ;
+  g2_to_affine(xQ, yQ, q);
+  Fp2 X = xQ, Y = yQ, Z = FP2_ONE_;
   f = FP12_ONE_;
   int top = 63;
   while (!((ATE_LOOP >> top) & 1)) top--;
-  Fp12 l;
+  Fp2 A, B, C, t, t2;
   for (int i = top - 1; i >= 0; i--) {
-    fp12_sqr(f, f);
-    line_eval(l, T, T, px12, py12);
-    fp12_mul(f, f, l);
-    e12_add(T, T, T);
+    fp12_sqr_fast(f, f);
+    // --- doubling step: line scaled by 2YZ^2 ---
+    Fp2 XX, YY, X3c, YZ, YYZ;
+    fp2_sqr(XX, X);
+    fp2_sqr(YY, Y);
+    fp2_mul(X3c, X, XX);  // X^3
+    fp2_mul(YZ, Y, Z);
+    fp2_mul(YYZ, YY, Z);
+    // A = 3X^3 - 2Y^2Z
+    fp2_add(t, X3c, X3c);
+    fp2_add(A, t, X3c);
+    fp2_add(t, YYZ, YYZ);
+    fp2_sub(A, A, t);
+    // B = -3*X^2*Z*px
+    Fp2 XXZ;
+    fp2_mul(XXZ, XX, Z);
+    fp2_add(t, XXZ, XXZ);
+    fp2_add(t, t, XXZ);
+    fp_mul(B.c0, t.c0, px);
+    fp_mul(B.c1, t.c1, px);
+    fp2_neg(B, B);
+    // C = 2*Y*Z^2*py
+    Fp2 YZZ;
+    fp2_mul(YZZ, YZ, Z);
+    fp2_add(t, YZZ, YZZ);
+    fp_mul(C.c0, t.c0, py);
+    fp_mul(C.c1, t.c1, py);
+    fp12_mul_sparse(f, A, B, C);
+    // T = 2T:  X3 = 2XYZ(9X^3 - 8Y^2Z); Y3 = 36X^3*YYZ - 27X^6 - 8(YYZ)^2;
+    //          Z3 = 8(YZ)^3
+    Fp2 XYZ, nine_x3, eight_yyz, X3n, Y3n, Z3n, x3sq, yyzsq, yz2;
+    fp2_mul(XYZ, X, YZ);
+    fp2_add(t, X3c, X3c);          // 2X^3
+    fp2_add(t2, t, t);             // 4X^3
+    fp2_add(t2, t2, t2);           // 8X^3
+    fp2_add(nine_x3, t2, X3c);     // 9X^3
+    fp2_add(t, YYZ, YYZ);          // 2YYZ
+    fp2_add(t2, t, t);             // 4YYZ
+    fp2_add(eight_yyz, t2, t2);    // 8YYZ
+    fp2_sub(t, nine_x3, eight_yyz);
+    fp2_mul(X3n, XYZ, t);
+    fp2_add(X3n, X3n, X3n);
+    fp2_sqr(x3sq, X3c);            // X^6
+    fp2_sqr(yyzsq, YYZ);
+    fp2_mul(t, X3c, YYZ);          // X^3*Y^2*Z
+    Fp2 acc;
+    fp2_add(acc, t, t);            // 2
+    fp2_add(acc, acc, acc);        // 4
+    fp2_add(acc, acc, acc);        // 8
+    fp2_add(acc, acc, t);          // 9
+    fp2_add(t2, acc, acc);         // 18
+    fp2_add(Y3n, t2, t2);          // 36*X^3*YYZ
+    {
+      // 27*X^6 = 16 + 8 + 2 + 1
+      Fp2 two, four, eight, sixteen;
+      fp2_add(two, x3sq, x3sq);
+      fp2_add(four, two, two);
+      fp2_add(eight, four, four);
+      fp2_add(sixteen, eight, eight);
+      fp2_add(t, sixteen, eight);
+      fp2_add(t, t, two);
+      fp2_add(t, t, x3sq);
+    }
+    fp2_sub(Y3n, Y3n, t);
+    fp2_add(t, yyzsq, yyzsq);
+    fp2_add(t2, t, t);
+    fp2_add(t, t2, t2);  // 8 (YYZ)^2
+    fp2_sub(Y3n, Y3n, t);
+    fp2_sqr(yz2, YZ);
+    fp2_mul(Z3n, yz2, YZ);  // (YZ)^3
+    fp2_add(Z3n, Z3n, Z3n);
+    fp2_add(t, Z3n, Z3n);
+    fp2_add(Z3n, t, t);  // 8 (YZ)^3
+    X = X3n;
+    Y = Y3n;
+    Z = Z3n;
     if ((ATE_LOOP >> i) & 1) {
-      line_eval(l, T, Q, px12, py12);
-      fp12_mul(f, f, l);
-      e12_add(T, T, Q);
+      // --- mixed addition step (Q affine): line through Q, scaled by D ---
+      Fp2 N, D, NN, DD, DDZ, xqz, yqz;
+      fp2_mul(xqz, xQ, Z);
+      fp2_mul(yqz, yQ, Z);
+      fp2_sub(N, Y, yqz);
+      fp2_sub(D, X, xqz);
+      // A = N*xQ - yQ*D ; B = -N*px ; C = D*py
+      fp2_mul(A, N, xQ);
+      fp2_mul(t, yQ, D);
+      fp2_sub(A, A, t);
+      fp_mul(B.c0, N.c0, px);
+      fp_mul(B.c1, N.c1, px);
+      fp2_neg(B, B);
+      fp_mul(C.c0, D.c0, py);
+      fp_mul(C.c1, D.c1, py);
+      fp12_mul_sparse(f, A, B, C);
+      // T = T + Q: t = N^2*Z - D^2*(X + xQ*Z);
+      //            X3 = D*t; Z3 = D^3*Z; Y3 = N*(xQ*D^2*Z - t) - yQ*D^3*Z
+      fp2_sqr(NN, N);
+      fp2_sqr(DD, D);
+      fp2_mul(DDZ, DD, Z);
+      Fp2 u_;
+      fp2_mul(u_, NN, Z);
+      fp2_mul(t2, DD, X);
+      fp2_sub(u_, u_, t2);
+      fp2_mul(t2, xQ, DDZ);
+      fp2_sub(u_, u_, t2);  // u_ = t
+      fp2_mul(X3n, D, u_);
+      Fp2 D3Z;
+      fp2_mul(D3Z, DD, D);
+      fp2_mul(D3Z, D3Z, Z);
+      fp2_mul(t, xQ, DDZ);
+      fp2_sub(t, t, u_);
+      fp2_mul(Y3n, N, t);
+      fp2_mul(t, yQ, D3Z);
+      fp2_sub(Y3n, Y3n, t);
+      X = X3n;
+      Y = Y3n;
+      Z = D3Z;
     }
   }
   Fp12 fc;
@@ -1065,16 +1147,70 @@ static void miller_loop(Fp12 &f, const G1 &p, const G2 &q) {
   f = fc;
 }
 
-// hard-part digits of (p^4-p^2+1)/r in base p (generated by the oracle)
-static const u64 HARD_DIGITS[4][6] = {
-    {0xaaaa0000aaaaaaacull, 0x33813d5206aa1800ull, 0x665a045e22ec661full,
-     0xf7a34148de09bf34ull, 0x2b688550f8cebd66ull, 0x1a0111ea397fe69aull},
-    {0x73ffffffffff5554ull, 0x9d586d584eacaaaaull, 0xc49f25e1a737f5e2ull,
-     0x26a48d1bb889d46dull, 0x0000000000000000ull, 0x0000000000000000ull},
-    {0x1ea8ffff5554aaabull, 0xb27c92a7df51e7feull, 0x38158e5c24aff488ull,
-     0x64774b84f38512bfull, 0x4b1ba7b6434bacd7ull, 0x1a0111ea397fe69aull},
-    {0x8c00aaab0000aaaaull, 0x396c8c005555e156ull, 0x0000000000000000ull,
-     0x0000000000000000ull, 0x0000000000000000ull, 0x0000000000000000ull}};
+// --- cyclotomic arithmetic for the final exponentiation -------------------
+
+// Fp4 = Fp2[sigma]/(sigma^2 - xi) squaring: (a + b sigma)^2
+static inline void fp4_sqr(Fp2 &ra, Fp2 &rb, const Fp2 &a, const Fp2 &b) {
+  Fp2 t0, t1, t2;
+  fp2_sqr(t0, a);
+  fp2_sqr(t1, b);
+  fp2_add(t2, a, b);
+  fp2_sqr(t2, t2);
+  fp2_mul_xi(ra, t1);
+  fp2_add(ra, ra, t0);  // a^2 + xi b^2
+  fp2_sub(rb, t2, t0);
+  fp2_sub(rb, rb, t1);  // 2ab
+}
+
+static bool CYC_OK = false;  // init self-check gates the fast path
+
+// Granger-Scott squaring for unitary elements. Fp4 pairs in this tower:
+// A = (c0.c0, c1.c1), B = (c1.c0, c0.c2), C = (c0.c1, c1.c2).
+//   A' = 3*A^2 - 2*conj(A); B' = 3*sigma*C^2 + 2*conj(B);
+//   C' = 3*B^2 - 2*conj(C);   sigma*(x + y*sigma) = xi*y + x*sigma.
+static void fp12_sqr_cyc(Fp12 &z, const Fp12 &a) {
+  if (!CYC_OK) {
+    fp12_sqr_fast(z, a);
+    return;
+  }
+  Fp2 sa_a, sa_b, sb_a, sb_b, sc_a, sc_b, t;
+  fp4_sqr(sa_a, sa_b, a.c0.c0, a.c1.c1);
+  fp4_sqr(sb_a, sb_b, a.c1.c0, a.c0.c2);
+  fp4_sqr(sc_a, sc_b, a.c0.c1, a.c1.c2);
+  // A' -> (c0.c0, c1.c1): re = 3*sa_a - 2*re; im = 3*sa_b + 2*im
+  Fp2 r;
+  fp2_sub(r, sa_a, a.c0.c0);
+  fp2_add(r, r, r);
+  fp2_add(z.c0.c0, r, sa_a);
+  fp2_add(r, sa_b, a.c1.c1);
+  fp2_add(r, r, r);
+  fp2_add(z.c1.c1, r, sa_b);
+  // B' -> (c1.c0, c0.c2): sigma*C^2 = (xi*sc_b, sc_a)
+  fp2_mul_xi(t, sc_b);
+  fp2_add(r, t, a.c1.c0);
+  fp2_add(r, r, r);
+  fp2_add(z.c1.c0, r, t);
+  fp2_sub(r, sc_a, a.c0.c2);
+  fp2_add(r, r, r);
+  fp2_add(z.c0.c2, r, sc_a);
+  // C' -> (c0.c1, c1.c2): re = 3*sb_a - 2*re; im = 3*sb_b + 2*im
+  fp2_sub(r, sb_a, a.c0.c1);
+  fp2_add(r, r, r);
+  fp2_add(z.c0.c1, r, sb_a);
+  fp2_add(r, sb_b, a.c1.c2);
+  fp2_add(r, r, r);
+  fp2_add(z.c1.c2, r, sb_b);
+}
+
+// g^|x| for cyclotomic g (|x| = ATE_LOOP), then conjugate for g^x (x < 0)
+static void cyc_exp_x(Fp12 &out, const Fp12 &g) {
+  Fp12 acc = g;
+  for (int i = 62; i >= 0; i--) {
+    fp12_sqr_cyc(acc, acc);
+    if ((ATE_LOOP >> i) & 1) fp12_mul(acc, acc, g);
+  }
+  fp12_conj(out, acc);  // x negative
+}
 
 static void final_exponentiation(Fp12 &out, const Fp12 &f) {
   // easy part
@@ -1084,27 +1220,64 @@ static void final_exponentiation(Fp12 &out, const Fp12 &f) {
   fp12_mul(t, t, finv);  // f^(p^6-1)
   fp12_frobenius(g, t);
   fp12_frobenius(g, g);
-  fp12_mul(t, g, t);  // ^(p^2+1)
-  // hard part: 4-way Shamir over base-p digits with Frobenius powers
-  Fp12 frobs[4];
-  frobs[0] = t;
-  for (int i = 1; i < 4; i++) fp12_frobenius(frobs[i], frobs[i - 1]);
-  Fp12 table[16];
-  table[0] = FP12_ONE_;
-  for (int m = 1; m < 16; m++) {
-    int low = m & (-m);
-    int idx = __builtin_ctz(low);
-    fp12_mul(table[m], table[m ^ low], frobs[idx]);
-  }
-  Fp12 acc = FP12_ONE_;
-  for (int i = 383; i >= 0; i--) {
-    fp12_sqr(acc, acc);
-    int mask = 0;
-    for (int j = 0; j < 4; j++)
-      if ((HARD_DIGITS[j][i / 64] >> (i % 64)) & 1) mask |= 1 << j;
-    if (mask) fp12_mul(acc, acc, table[mask]);
-  }
-  out = acc;
+  fp12_mul(t, g, t);  // ^(p^2+1) — now in the cyclotomic subgroup
+  // hard part: exponent 3h, h = (p^4-p^2+1)/r, via the
+  // Hayashida-Hayasaka-Teruya lambda chain (verified symbolically:
+  // lambda0 + lambda1*p + lambda2*p^2 + lambda3*p^3 == 3h with
+  // l3=(x-1)^2, l2=x*l3, l1=x^4-2x^3+2x-1, l0=x^5-2x^4+2x^2-x+3).
+  // The framework's GT convention is this CUBED ate pairing — matching
+  // crypto/bls12381.py final_exponentiation; gcd(3, r) = 1 so every
+  // pairing equality check is unaffected.
+  Fp12 t0, t1, t3, t4, t5, t6, t6b, tmp, accA, accB, accC, accD;
+  cyc_exp_x(t3, t);  // t^x
+  fp12_sqr_cyc(t1, t);
+  fp12_conj(t1, t1);     // t^-2
+  fp12_mul(t5, t3, t1);  // t^(x-2)
+  cyc_exp_x(t1, t5);     // t^(x^2-2x)
+  cyc_exp_x(t0, t1);     // t^(x^3-2x^2)
+  cyc_exp_x(t6, t0);     // t^(x^4-2x^3)
+  fp12_sqr_cyc(t4, t3);  // t^(2x)
+  fp12_mul(t6, t6, t4);  // t^(x^4-2x^3+2x)
+  fp12_conj(tmp, t);
+  fp12_mul(t6b, t6, tmp);  // ^lambda1
+  cyc_exp_x(t4, t6);       // t^(x^5-2x^4+2x^2)
+  fp12_conj(tmp, t5);
+  fp12_mul(accA, t4, tmp);
+  fp12_mul(accA, accA, t);  // ^lambda0
+  fp12_mul(accC, t0, t3);   // ^lambda2
+  fp12_mul(accD, t1, t);    // ^lambda3
+  fp12_frobenius(accB, t6b);
+  fp12_frobenius(accC, accC);
+  fp12_frobenius(accC, accC);
+  fp12_frobenius(accD, accD);
+  fp12_frobenius(accD, accD);
+  fp12_frobenius(accD, accD);
+  fp12_mul(out, accA, accB);
+  fp12_mul(out, out, accC);
+  fp12_mul(out, out, accD);
+}
+
+// init-time self-check for the Granger-Scott squaring sign conventions:
+// build a cyclotomic element, compare fp12_sqr_cyc against the always-
+// correct fp12_sqr_fast; on mismatch the slow-but-correct path stays.
+// Called from the _init constructor AFTER field constants exist.
+static void cyc_selfcheck() {
+  Fp12 e = FP12_ONE_;
+  e.c0.c1.c0 = MONT_ONE;
+  e.c1.c0.c1 = MONT_ONE;
+  e.c1.c2.c0 = MONT_ONE;
+  Fp12 c, inv, u, fr;
+  fp12_conj(c, e);
+  fp12_inv(inv, e);
+  fp12_mul(u, c, inv);
+  fp12_frobenius(fr, u);
+  fp12_frobenius(fr, fr);
+  fp12_mul(u, fr, u);  // cyclotomic
+  Fp12 a, b;
+  CYC_OK = true;
+  fp12_sqr_cyc(a, u);
+  fp12_sqr_fast(b, u);
+  CYC_OK = fp12_eq(a, b);
 }
 
 // ===========================================================================
@@ -1485,24 +1658,10 @@ static struct Init {
     GAMMA[1] = g1x;
     for (int i = 2; i < 6; i++) fp2_mul(GAMMA[i], GAMMA[i - 1], GAMMA[1]);
 
-    // w^2 = v, w^3 = v*w and inverses
-    Fp12 w2 = FP12_ZERO_, w3 = FP12_ZERO_;
-    w2.c0.c1 = FP2_ONE_;  // v
-    w3.c1.c1 = FP2_ONE_;  // v*w
-    fp12_inv(W2_INV, w2);
-    fp12_inv(W3_INV, w3);
-
-    FP12_THREE = FP12_ZERO_;
-    Fp three;
-    fp_set_u64(three, 3);
-    FP12_THREE.c0.c0.c0 = three;
-    FP12_TWO = FP12_ZERO_;
-    Fp two;
-    fp_set_u64(two, 2);
-    FP12_TWO.c0.c0.c0 = two;
-
     H_G1_BYTES = hex_to_bytes(H_G1_HEX);
     H_G2_BYTES = hex_to_bytes(H_G2_HEX);
+
+    cyc_selfcheck();
   }
 } _init;
 

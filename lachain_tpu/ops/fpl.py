@@ -1,0 +1,172 @@
+"""Loose (redundant, signed-limb) BLS12-381 Fp arithmetic — the MSM hot path.
+
+Profiling the exact field module (ops/fp.py) showed ~75% of kernel time in
+carry normalization: every add/sub/mul ran one or two 32-step `lax.scan`
+ripple-carry chains plus a conditional subtract, each iteration a tiny op
+dominated by loop-sync latency on TPU. This module removes ALL of that from
+the hot path by making the REPRESENTATION modular instead of the schedule
+clever:
+
+  * An element is 44 limbs x 10 bits of signed int32 (trailing axis
+    (..., 44), R = 2^440 — wide headroom over the 381-bit modulus).
+  * Any limb vector is a legal representative of its residue; limbs may be
+    negative. Exact canonicalization happens only on host at the kernel
+    boundary.
+  * `crush` — the only normalization primitive — is fully modular: each
+    round folds per-limb overflow into the next limb, and the TOP limb's
+    carry wraps through the identity 2^440 ≡ (2^440 mod p) (mod p) by
+    adding carry_top * FOLD_LIMBS. Nothing is ever dropped (a dropped top
+    carry would shift the value by k*2^440 != 0 mod p — the bug class that
+    sank two earlier designs of this module), so every op preserves the
+    residue exactly with NO value-range bookkeeping at all.
+  * add/sub/neg are plain limb arithmetic + crush(2): no scans, no
+    conditional subtract, negatives included.
+  * mont_mul is one convolution matmul + 44 statically unrolled CIOS rounds
+    + crush(3). Pure elementwise chains; XLA fuses them.
+
+Magnitude invariants (fuzz-checked in tests/test_msm.py):
+  every op's output limbs satisfy |limb| <= 2^10 + 2^8 + 2^10 < 2^11.2
+  conv coefficients: 44 * (2^11.2)^2 < 2^28  (signed int32 safe)
+  CIOS accumulators: conv + 44 * 2^20 < 2^28.3
+  top-limb carries: |carry_top| <= 4 in round 1, <= 1 after, and
+  FOLD_LIMBS is zero above limb 38, so folding converges in 2-3 rounds.
+
+Reference role: same as ops/fp.py (the Fp tower under MCL's G1 in
+/root/reference/src/Lachain.Crypto/MclBls12381.cs), re-specialized for
+latency: this is the module the windowed-MSM kernel (ops/msm.py) runs on.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto import bls12381 as bls
+
+NLIMBS = 44
+BASE = 10
+MASK = (1 << BASE) - 1
+NBITS = NLIMBS * BASE  # 440
+CONVLEN = 2 * NLIMBS - 1  # 87
+
+P_INT = bls.P
+R_MONT = (1 << NBITS) % P_INT
+PINV = (-pow(P_INT, -1, 1 << BASE)) % (1 << BASE)
+FOLD_INT = (1 << NBITS) % P_INT  # == R_MONT
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    assert v >= 0
+    return np.array(
+        [(v >> (BASE * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+    )
+
+
+def limbs_to_int(a) -> int:
+    """Signed limb vector -> exact integer value (host)."""
+    a = np.asarray(a)
+    return sum(int(a[i]) << (BASE * i) for i in range(NLIMBS))
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P_INT))
+ONE_MONT = jnp.asarray(int_to_limbs(R_MONT))
+FOLD_LIMBS = jnp.asarray(int_to_limbs(FOLD_INT))
+assert int(np.asarray(FOLD_LIMBS)[NLIMBS - 1]) == 0  # top fold limb empty
+R2_INT = R_MONT * R_MONT % P_INT
+
+
+def to_mont_host(v: int) -> np.ndarray:
+    return int_to_limbs(v * R_MONT % P_INT)
+
+
+def from_mont_host(a) -> int:
+    rinv = pow(R_MONT, -1, P_INT)
+    return limbs_to_int(a) * rinv % P_INT
+
+
+# one-hot anti-diagonal matrix: conv(x, y)[k] = sum_{i+j=k} x_i y_j as a
+# single int32 matmul (measured faster on TPU than a pad/reshape "skew"
+# formulation despite the extra MACs — reshapes of unaligned widths relayout
+# through HBM)
+_CONV_ONEHOT = np.zeros((NLIMBS * NLIMBS, CONVLEN), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV_ONEHOT[_i * NLIMBS + _j, _i + _j] = 1
+CONV_ONEHOT = jnp.asarray(_CONV_ONEHOT)
+
+
+def _conv(x, y):
+    outer = x[..., :, None] * y[..., None, :]
+    flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
+    return flat @ CONV_ONEHOT
+
+
+# Linear Montgomery reduction: REDC(t) = sum_k t_k * (2^10k * 2^-440 mod p)
+# — REDC is linear over the conv coefficients, so the whole 44-round CIOS
+# loop collapses into ONE matmul against precomputed residues. Coefficients
+# (|t_k| < 2^28) are split into three planes (10+10+8 bits, signed top) so
+# every product and the 261-term accumulation stay inside int32.
+_REDC_ROWS = np.zeros((3 * CONVLEN, NLIMBS), dtype=np.int32)
+for _j in range(3):  # plane shift: 2^(10*j)
+    for _k in range(CONVLEN):
+        _val = (1 << (BASE * (_k + _j))) * pow(1 << NBITS, -1, P_INT) % P_INT
+        _REDC_ROWS[_j * CONVLEN + _k] = int_to_limbs(_val)
+REDC_M = jnp.asarray(_REDC_ROWS)
+
+
+def redc(t):
+    """(..., CONVLEN) conv coefficients -> (..., NLIMBS) loose limbs of
+    t * 2^-440 mod p. Exact for any signed t with |t_k| < 2^28."""
+    a = t & MASK
+    b = (t >> BASE) & MASK
+    c = t >> (2 * BASE)  # signed, |c| <= 2^8
+    planes = jnp.concatenate([a, b, c], axis=-1)  # (..., 3*CONVLEN)
+    return crush(planes @ REDC_M, 3)
+
+
+def crush(t, rounds: int = 2):
+    """Modular carry fold: per-limb overflow moves one limb up; the top
+    limb's carry wraps around through FOLD_LIMBS (2^440 mod p). Exactly
+    preserves the value mod p for ANY signed input; arithmetic shifts
+    handle borrows."""
+    for _ in range(rounds):
+        carry = t >> BASE
+        top = carry[..., -1:]
+        t = (
+            (t & MASK)
+            + jnp.pad(carry[..., :-1], [(0, 0)] * (t.ndim - 1) + [(1, 0)])
+            + top * FOLD_LIMBS
+        )
+    return t
+
+
+def add(x, y):
+    # crush(1) suffices: inputs have |limb| <= ~2^11.2, so one round leaves
+    # |limb| <= 2^10 + 4 + 4*(2^10-1) < 2^12.1 and the conv bound
+    # 44*(2^12.1)^2 < 2^30.5 still clears int32
+    return crush(x + y, 1)
+
+
+def sub(x, y):
+    return crush(x - y, 1)
+
+
+def neg(x):
+    return crush(-x, 1)
+
+
+def mont_mul(x, y):
+    """x * y * 2^-440 mod p in loose form: one conv + one REDC matmul +
+    one crush. No sequential reduction rounds at all."""
+    x, y = jnp.broadcast_arrays(x, y)
+    return redc(_conv(x, y))
+
+
+def mont_sqr(x):
+    return mont_mul(x, x)
+
+
+def mul_small(x, k: int):
+    """x * k for a small int k (|k| <= ~16): exact, crushed."""
+    return crush(x * k)

@@ -131,6 +131,45 @@ def sharded_era_step(mesh: Mesh):
     return jax.jit(fn)
 
 
+def sharded_glv_era_step(mesh: Mesh):
+    """shard_map the round-2 GLV/windowed era kernel (ops/msm.py) over a
+    ('slot', 'share') mesh.
+
+    Same layout as sharded_era_step: slots are data-parallel; the share axis
+    shards within each slot. Each device runs the full windowed MSM over its
+    local share shard (tables, window scan, local flagged tree-reduce), then
+    the per-device partial sums are combined with an all_gather over 'share'
+    plus a replicated flagged point-add tree — point addition is not a psum,
+    so the combine is an explicit collective + local tree.
+    """
+    from ..ops import msm as M
+
+    def local_step(u, y, rlc, lag1, lag2):
+        pts, flags = M.tpke_era_glv_kernel(u, y, rlc, lag1, lag2)
+        # (S_local, 4, 3, L) local partials + (S_local, 4) flags
+        gp = jax.lax.all_gather(pts, "share")  # (nshare, S_l, 4, 3, L)
+        gf = jax.lax.all_gather(flags, "share")
+        return M.g1_tree_reduce_flagged(gp, gf, axis=0)
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(
+            P("slot", "share", None, None),
+            P("slot", "share", None, None),
+            P("slot", "share", None),
+            P("slot", "share", None),
+            P("slot", "share", None),
+        ),
+        out_specs=(
+            P("slot", None, None, None),
+            P("slot", None),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
 def pad_pow2(n: int, multiple: int) -> int:
     """Smallest power of two >= n that is divisible by `multiple`."""
     size = max(multiple, 1)
