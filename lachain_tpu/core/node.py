@@ -20,6 +20,7 @@ from ..consensus import messages as M
 from ..consensus.era import EraRouter
 from ..consensus.keys import PrivateConsensusKeys, PublicConsensusKeys
 from ..consensus.root_protocol import RootProtocol
+from ..crypto import ecdsa
 from ..network import wire
 from ..network.hub import PeerAddress
 from ..network.manager import NetworkManager
@@ -28,9 +29,13 @@ from ..storage.state import StateManager
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
 from .execution import TransactionExecuter, get_nonce
+from .keygen_manager import KeyGenManager
 from .synchronizer import BlockSynchronizer
 from .tx_pool import TransactionPool
-from .types import Block, SignedTransaction
+from .types import Block, SignedTransaction, Transaction, sign_transaction
+from .validator_manager import ValidatorManager
+from .validator_status import ValidatorStatusManager
+from .vault import PrivateWallet
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +57,7 @@ class Node:
         initial_balances: Optional[Dict[bytes, int]] = None,
         flush_interval: float = 0.02,
         executer: Optional[TransactionExecuter] = None,
+        wallet: Optional[PrivateWallet] = None,
     ):
         self.index = index
         self.public_keys = public_keys
@@ -79,8 +85,13 @@ class Node:
         self.network.on_consensus = self._on_consensus
         self.network.on_sync_pool_reply = self._on_pool_txs
         self.network.on_ping_request = self._on_ping_request
+        self.validator_manager = ValidatorManager(self.state, public_keys)
         self.synchronizer = BlockSynchronizer(
-            self.block_manager, self.pool, self.network, public_keys
+            self.block_manager,
+            self.pool,
+            self.network,
+            public_keys,
+            keys_provider=self.validator_manager.keys_for_era,
         )
         # validator index <-> transport identity
         self._pub_by_index: Dict[int, bytes] = {
@@ -92,6 +103,25 @@ class Node:
         self.router: Optional[EraRouter] = None
         self._era_done = asyncio.Event()
         self._stopping = False
+        # -- autonomous lifecycle services (reference Application.Start
+        #    wiring: KeyGenManager + ValidatorStatusManager hooked on block
+        #    persistence; PrivateWallet holds era-keyed threshold keys) -----
+        self.wallet = wallet or PrivateWallet(
+            ecdsa_priv=private_keys.ecdsa_priv
+        )
+        self._genesis_private = private_keys
+        self.ecdsa_pub = ecdsa.public_key_bytes(private_keys.ecdsa_priv)
+        self.address20 = ecdsa.address_from_public_key(self.ecdsa_pub)
+        self.keygen_manager = KeyGenManager(
+            private_keys.ecdsa_priv,
+            self._send_system_tx,
+            on_keys=self._install_rotated_keys,
+        )
+        self.validator_status = ValidatorStatusManager(
+            private_keys.ecdsa_priv, self._send_system_tx
+        )
+        self.block_manager.on_block_persisted.append(self._on_block_persisted)
+        self._height_event = asyncio.Event()
 
     # -- service lifecycle --------------------------------------------------
 
@@ -205,8 +235,19 @@ class Node:
             self.router.advance_era(era)
         return self.router
 
-    async def run_era(self, era: int, timeout: float = 120.0) -> Block:
-        """Run one era to completion; returns the produced block."""
+    async def run_era(
+        self, era: int, timeout: Optional[float] = 120.0
+    ) -> Block:
+        """Run one era to completion; returns the produced block.
+
+        A synced block at this height supersedes the local consensus run
+        (reference ConsensusManager.cs:339-349): the wait also wakes on
+        block persistence so a lagging validator cannot wedge on an era the
+        network already finished. With a timeout, TimeoutError is raised if
+        neither consensus nor sync makes progress in `timeout` seconds
+        total; timeout=None (the autonomous loop) waits indefinitely —
+        sync supersession is the recovery path there.
+        """
         router = self._ensure_router(era)
         self._era_done.clear()
         pid = M.RootProtocolId(era=era)
@@ -214,11 +255,178 @@ class Node:
             M.Request(from_id=None, to_id=pid, input=None)
         )
         self._check_era_done()
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
         while router.result_of(pid) is None:
+            if self.block_manager.current_height() >= era:
+                block = self.block_manager.block_by_height(era)
+                assert block is not None
+                return block
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"era {era} stalled")
             self._era_done.clear()
-            await asyncio.wait_for(self._era_done.wait(), timeout=timeout)
+            self._height_event.clear()
+            done = asyncio.ensure_future(self._era_done.wait())
+            height = asyncio.ensure_future(self._height_event.wait())
+            try:
+                await asyncio.wait(
+                    [done, height],
+                    timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            finally:
+                for fut in (done, height):
+                    fut.cancel()
         block = router.result_of(pid)
         return block
 
     async def run_eras(self, first: int, count: int) -> List[Block]:
         return [await self.run_era(first + i) for i in range(count)]
+
+    # -- autonomous lifecycle (reference ConsensusManager.Run, 191-360) ------
+
+    def _send_system_tx(self, to: bytes, invocation: bytes) -> None:
+        """KeyGenManager/ValidatorStatusManager outbound: build, sign, pool
+        and gossip a governance/staking transaction from the node's key."""
+        # system-contract calls bill the flat base fee only, so a modest
+        # limit keeps the up-front balance requirement tiny (a validator
+        # with most of its balance staked must still be able to emit
+        # lifecycle transactions)
+        tx = Transaction(
+            to=to,
+            value=0,
+            nonce=self.pool.next_nonce(self.address20),
+            gas_price=1,
+            gas_limit=100_000,
+            invocation=invocation,
+        )
+        stx = sign_transaction(tx, self.private_keys.ecdsa_priv, self.chain_id)
+        self.submit_tx(stx)
+
+    def _install_rotated_keys(self, first_era, keyring, participants) -> None:
+        """DKG finished: stash this node's new shares in the era-keyed
+        wallet (reference GovernanceContract.ChangeValidators ->
+        PrivateWallet.AddThresholdSignatureKeyAfterBlock)."""
+        self.wallet.add_threshold_keys(
+            first_era, keyring.tpke_priv, keyring.ts_share
+        )
+        logger.info(
+            "node %d: rotated threshold keys installed from era %d",
+            self.index,
+            first_era,
+        )
+
+    def _on_block_persisted(self, block: Block) -> None:
+        snap = self.state.new_snapshot()
+        self.validator_status.on_block_persisted(block, snap)
+        self.keygen_manager.on_block_persisted(block, snap)
+        self._height_event.set()
+
+    async def _wait_height(self, height: int) -> None:
+        while self.block_manager.current_height() < height:
+            self._height_event.clear()
+            if self.block_manager.current_height() >= height:
+                break
+            try:
+                await asyncio.wait_for(self._height_event.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _rekey_for_era(self, era: int) -> Optional[int]:
+        """Reconfigure consensus identity for `era` from the era-1 snapshot
+        (ValidatorManager) and the wallet's era-keyed shares. Returns this
+        node's validator index, or None when it sits this era out."""
+        keys = self.validator_manager.keys_for_era(era)
+        if keys is not self.public_keys:
+            # ValidatorManager returns one stable object per distinct set,
+            # so identity comparison is exact change detection
+            self.public_keys = keys
+            self._pub_by_index = {
+                i: pk for i, pk in enumerate(keys.ecdsa_pub_keys)
+            }
+            self._index_by_pub = {
+                pk: i for i, pk in self._pub_by_index.items()
+            }
+            self.producer.n = keys.n
+        try:
+            my_index = keys.ecdsa_pub_keys.index(self.ecdsa_pub)
+        except ValueError:
+            # demoted to observer: drop the stale-era router and identity so
+            # inbound messages from the NEW set are never attributed into an
+            # OLD-set router (index tables were just rebuilt above)
+            self.router = None
+            self.index = -1
+            return None
+        priv = self._private_keys_matching(keys, my_index, era)
+        if priv is None:
+            logger.warning(
+                "node %d: in validator set for era %d but holds no matching "
+                "threshold keys — observing",
+                self.index,
+                era,
+            )
+            self.router = None
+            self.index = -1
+            return None
+        self.private_keys = priv
+        self.index = my_index
+        return my_index
+
+    def _private_keys_matching(
+        self, keys: PublicConsensusKeys, my_index: int, era: int
+    ) -> Optional[PrivateConsensusKeys]:
+        """The private share set whose TPKE verification key matches slot
+        `my_index` of the era's PUBLIC set. Checking the match (one scalar
+        mul) instead of trusting the wallet's era arithmetic protects
+        against a rotation whose on-chain flip slipped a cycle: wallet keys
+        installed for era E must not be used while an older set still
+        governs (reference rescans keys at era start,
+        ConsensusManager.cs:250-266)."""
+        from ..crypto import bls12381 as bls
+
+        want_vk = keys.tpke_verification_keys[my_index].y_i
+        candidates = []
+        wallet_keys = self.wallet.consensus_keys_for_era(era)
+        if wallet_keys is not None:
+            candidates.append(wallet_keys)
+        candidates.append(self._genesis_private)
+        for cand in candidates:
+            if cand.tpke_priv is None or cand.tpke_priv.my_id != my_index:
+                continue
+            y = bls.g1_mul(bls.G1_GEN, cand.tpke_priv.x_i)
+            if bls.g1_to_affine(y) == bls.g1_to_affine(want_vk):
+                return cand
+        return None
+
+    async def run(self, first_era: int = 1, stop_at: Optional[int] = None) -> None:
+        """The autonomous era loop (reference ConsensusManager.Run,
+        ConsensusManager.cs:191-360): wait for block era-1, load the era's
+        validator set from the era-1 snapshot and the era's keys from the
+        wallet, run consensus if a member (sync supersedes a stalled era),
+        fire persistence hooks, GC, advance."""
+        era = first_era
+        while not self._stopping and (stop_at is None or era <= stop_at):
+            await self._wait_height(era - 1)
+            if self._stopping:
+                return
+            my_index = self._rekey_for_era(era)
+            if my_index is None:
+                await self._wait_height(era)  # observer for this era
+            else:
+                self._rebuild_router(era)
+                await self.run_era(era)
+            era += 1
+
+    def _rebuild_router(self, era: int) -> None:
+        """Router for `era` under the CURRENT key set. Unlike
+        _ensure_router, this also swaps identity when rotation changed the
+        validator set."""
+        if (
+            self.router is not None
+            and self.router.public_keys is not self.public_keys
+        ):
+            self.router = None  # key set changed: a fresh router is required
+        self._ensure_router(era)

@@ -55,11 +55,20 @@ class BlockSynchronizer:
         public_keys: PublicConsensusKeys,
         *,
         ping_interval: float = 1.0,
+        keys_provider=None,
     ):
         self.bm = block_manager
         self.pool = pool
         self.network = network
         self.public_keys = public_keys
+        # height -> PublicConsensusKeys: with on-chain validator rotation the
+        # multisig quorum for block H must be checked against the set that
+        # governed era H (ValidatorManager role). The default reads
+        # self.public_keys dynamically so assigning that attribute stays
+        # meaningful for fixed-set users.
+        self.keys_provider = keys_provider or (
+            lambda height: self.public_keys
+        )
         self.ping_interval = ping_interval
         self.peer_heights: Dict[bytes, int] = {}
         self._tasks: List[asyncio.Task] = []
@@ -236,7 +245,9 @@ class BlockSynchronizer:
         if prev is not None and block.header.prev_block_hash != prev.hash():
             logger.warning("synced block %d does not link", block.header.index)
             return False
-        if not verify_block_multisig(block, self.public_keys):
+        if not verify_block_multisig(
+            block, self.keys_provider(block.header.index)
+        ):
             logger.warning(
                 "synced block %d lacks a signature quorum", block.header.index
             )

@@ -388,6 +388,13 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
         ctx.emit(GOVERNANCE_ADDRESS, b"change_validators")
         return 1, b""
     if sel == SEL_FINISH_CYCLE:
+        # only the cycle's LAST block may rotate the set: the new keys are
+        # wallet-installed from era (cycle+1)*CYCLE_DURATION, so the
+        # validator-set flip must land in the snapshot of exactly the block
+        # before (reference injects FinishCycle as a cycle-boundary system
+        # tx, BlockProducer.cs:126-146)
+        if ctx.block % CYCLE_DURATION != CYCLE_DURATION - 1:
+            return 0, b""
         pending = ctx.sget(GOVERNANCE_ADDRESS, b"pending_validators")
         if pending:
             ctx.snap.put("validators", b"current", pending)

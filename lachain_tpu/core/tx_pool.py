@@ -68,6 +68,15 @@ class TransactionPool:
             return True
 
     # -- proposal --------------------------------------------------------------
+    def next_nonce(self, sender: bytes) -> int:
+        """Next usable nonce for `sender`: the account nonce advanced past
+        any consecutive pending transactions already in the pool."""
+        with self._lock:
+            nonce = self._account_nonce(sender)
+            while (sender, nonce) in self._by_nonce:
+                nonce += 1
+            return nonce
+
     def peek(self, max_txs: int) -> List[SignedTransaction]:
         """Fee-ordered proposal with per-sender nonce continuity."""
         with self._lock:

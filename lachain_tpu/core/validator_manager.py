@@ -1,0 +1,53 @@
+"""ValidatorManager: the consensus key set for an era, read from chain state.
+
+Parity with the reference's ValidatorManager
+(/root/reference/src/Lachain.Core/Blockchain/Validators/ValidatorManager.cs:
+25-60): the validator set for era E is whatever the `validators/current`
+entry held in the state snapshot of block E-1 (written by the governance
+contract's FinishCycle — core/system_contracts.py), cached per era; the
+genesis key set applies until the first rotation lands.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..consensus.keys import PublicConsensusKeys
+from ..storage.state import StateManager
+
+
+class ValidatorManager:
+    def __init__(self, state: StateManager, genesis_keys: PublicConsensusKeys):
+        self._state = state
+        self.genesis_keys = genesis_keys
+        self._cache: Dict[int, PublicConsensusKeys] = {}
+        self._decoded: Dict[bytes, PublicConsensusKeys] = {}
+
+    def keys_for_era(self, era: int) -> PublicConsensusKeys:
+        """Key set governing era `era` (block height `era`). Requires block
+        era-1 to be persisted; falls back to the genesis set before any
+        rotation (or for era 0)."""
+        if era in self._cache:
+            return self._cache[era]
+        if era <= 0:
+            return self.genesis_keys
+        roots = self._state.roots_at(era - 1)
+        if roots is None:
+            # barrier not met — the caller (era loop / synchronizer) only
+            # asks after block era-1 persisted; default to genesis rather
+            # than raise so observers can bootstrap
+            return self.genesis_keys
+        snap = self._state.new_snapshot(roots)
+        raw = snap.get("validators", b"current")
+        if raw is None:
+            keys = self.genesis_keys
+        else:
+            # one decoded object per distinct set, so consecutive eras under
+            # the same set share identity (cheap change detection upstream)
+            keys = self._decoded.get(raw)
+            if keys is None:
+                keys = PublicConsensusKeys.decode(raw)
+                self._decoded[raw] = keys
+        self._cache[era] = keys
+        if len(self._cache) > 64:
+            self._cache.pop(min(self._cache))
+        return keys

@@ -53,7 +53,14 @@ class ValidatorStatusManager:
         height = block.header.index
         cycle = height // self._cycle_duration
         in_phase = height % self._cycle_duration < self._vrf_phase
-        if not in_phase or cycle in self._submitted_cycles:
+        if not in_phase:
+            # submission phase over: close the lottery if nobody has yet
+            # (reference injects FinishVrfLottery as a system tx at the
+            # phase boundary, BlockProducer.cs:126-146; here every validator
+            # offers the closing tx and the contract dedupes)
+            self._maybe_finish_lottery(cycle, snap)
+            return
+        if cycle in self._submitted_cycles:
             return
         stake = self.stake_of(snap)
         if stake == 0:
@@ -80,6 +87,18 @@ class ValidatorStatusManager:
             + write_bytes(self.public_key)
             + write_bytes(proof),
         )
+
+    def _maybe_finish_lottery(self, cycle: int, snap: Snapshot) -> None:
+        # self-healing: re-offer every block until the on-chain
+        # lottery_done flag appears — a lost or mistimed close tx must not
+        # skip the cycle's rotation (no local one-shot latch; the chain
+        # state IS the dedupe)
+        winners = self._storage(snap, b"winners:" + write_u64(cycle))
+        done = self._storage(snap, b"lottery_done:" + write_u64(cycle))
+        if winners is None or done is not None:
+            return
+        logger.info("cycle %d: closing the VRF lottery", cycle)
+        self._send_tx(sc.STAKING_ADDRESS, sc.SEL_FINISH_LOTTERY + b"")
 
     # -- stake lifecycle ----------------------------------------------------
 
