@@ -1,0 +1,266 @@
+"""lachain-tpu operator CLI: the runnable node process.
+
+Parity with the reference's console
+(/root/reference/src/Lachain.Console/Program.cs:23-47 verbs,
+TrustedKeygen.cs:56-66 devnet generation, Application.cs:67-198 service
+composition):
+
+  lachain-tpu keygen --n 4 --f 1 --out netdir [--port-base 7070]
+      trusted-dealer devnet generation: writes config{i}.json +
+      wallet{i}.json for every validator, cross-wired as peers.
+  lachain-tpu run --config netdir/config0.json
+      boots a full node from a config: wallet, network, sync, RPC, and
+      the autonomous era lifecycle.
+  lachain-tpu height --config netdir/config0.json
+      one-shot local status (height + validator set) without RPC.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import secrets
+import signal
+import sys
+from typing import List
+
+logger = logging.getLogger("lachain_tpu.cli")
+
+
+# ---------------------------------------------------------------------------
+# keygen
+# ---------------------------------------------------------------------------
+
+
+def cmd_keygen(args) -> int:
+    from .consensus.keys import trusted_key_gen
+    from .core.config import CURRENT_VERSION
+    from .core.vault import PrivateWallet
+    from .crypto import ecdsa
+
+    n, f = args.n, args.f
+    if n <= 3 * f:
+        print(f"need n > 3f (got n={n}, f={f})", file=sys.stderr)
+        return 2
+    os.makedirs(args.out, exist_ok=True)
+    pub, privs = trusted_key_gen(n, f)
+    peers: List[str] = []
+    for i in range(n):
+        port = args.port_base + 2 * i
+        peers.append(
+            f"{args.host}:{port}:{pub.ecdsa_pub_keys[i].hex()}"
+        )
+    balances = {}
+    for i in range(n):
+        addr = ecdsa.address_from_public_key(pub.ecdsa_pub_keys[i])
+        balances["0x" + addr.hex()] = str(args.initial_balance)
+    for extra in args.fund or []:
+        balances[extra] = str(args.initial_balance)
+    consensus_hex = pub.encode().hex()
+    for i in range(n):
+        wallet_path = os.path.join(args.out, f"wallet{i}.json")
+        password = secrets.token_hex(8) if args.encrypt else ""
+        wallet = PrivateWallet(
+            path=wallet_path,
+            password=password,
+            ecdsa_priv=privs[i].ecdsa_priv,
+        )
+        wallet.add_threshold_keys(0, privs[i].tpke_priv, privs[i].ts_share)
+        wallet.save()
+        cfg = {
+            "version": CURRENT_VERSION,
+            "network": {
+                "host": args.host,
+                "port": args.port_base + 2 * i,
+                "peers": [p for j, p in enumerate(peers) if j != i],
+            },
+            "genesis": {
+                "chainId": args.chain_id,
+                "balances": balances,
+                "consensusKeys": consensus_hex,
+                "validatorIndex": i,
+            },
+            "vault": {"path": wallet_path, "password": password},
+            "staking": {
+                "cycleDuration": args.cycle_duration,
+                "vrfSubmissionPhase": args.vrf_phase,
+            },
+            "rpc": {
+                "enabled": True,
+                "host": "127.0.0.1",
+                "port": args.port_base + 2 * i + 1,
+                "apiKey": None,
+            },
+            "blockchain": {"targetTxsPerBlock": 1000, "targetBlockTimeMs": args.block_time_ms},
+            "hardfork": {"heights": {}},
+        }
+        path = os.path.join(args.out, f"config{i}.json")
+        with open(path, "w") as fh:
+            json.dump(cfg, fh, indent=2, sort_keys=True)
+        print(path)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# run
+# ---------------------------------------------------------------------------
+
+
+def _build_node(cfg, db_path=None):
+    from .consensus.keys import PrivateConsensusKeys, PublicConsensusKeys
+    from .core import system_contracts as sc
+    from .core.hardforks import set_hardfork_heights
+    from .core.node import Node
+    from .core.vault import PrivateWallet
+    from .network.hub import PeerAddress
+
+    sc.set_cycle_params(
+        cfg.staking.cycle_duration, cfg.staking.vrf_submission_phase
+    )
+    if cfg.hardfork.heights:
+        set_hardfork_heights(cfg.hardfork.heights, force=True)
+    wallet = PrivateWallet.load(cfg.vault.path, cfg.vault.password)
+    pub = PublicConsensusKeys.decode(bytes.fromhex(cfg.genesis.consensus_keys))
+    idx = cfg.genesis.validator_index
+    priv = wallet.consensus_keys_for_era(0)
+    if priv is None or idx < 0:
+        priv = PrivateConsensusKeys.observer(wallet.ecdsa_priv)
+        idx = -1
+    balances = {
+        bytes.fromhex(a[2:]): int(v) for a, v in cfg.genesis.balances.items()
+    }
+    node = Node(
+        index=idx,
+        public_keys=pub,
+        private_keys=priv,
+        chain_id=cfg.genesis.chain_id,
+        host=cfg.network.host,
+        port=cfg.network.port,
+        initial_balances=balances,
+        txs_per_block=cfg.blockchain.target_txs_per_block,
+        wallet=wallet,
+        block_interval=cfg.blockchain.target_block_time_ms / 1000.0,
+    )
+    peers = []
+    for spec in cfg.network.peers:
+        host, port, pubhex = spec.rsplit(":", 2)
+        peers.append(
+            PeerAddress(
+                public_key=bytes.fromhex(pubhex), host=host, port=int(port)
+            )
+        )
+    return node, peers
+
+
+async def _run_node(cfg, args) -> None:
+    node, peers = _build_node(cfg)
+    await node.start()
+    node.connect(peers)
+    rpc = None
+    if cfg.rpc.enabled:
+        rpc = await node.start_rpc(
+            cfg.rpc.host, cfg.rpc.port, api_key=cfg.rpc.api_key
+        )
+        print(f"rpc: http://{cfg.rpc.host}:{rpc.port}", flush=True)
+    if args.stake:
+        node.validator_status.become_staker(int(args.stake))
+
+    stop = asyncio.Event()
+
+    def _sig(*_a):
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    for s in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(s, _sig)
+        except NotImplementedError:
+            pass
+
+    run_task = asyncio.ensure_future(
+        node.run(first_era=node.block_manager.current_height() + 1)
+    )
+    stop_task = asyncio.ensure_future(stop.wait())
+    await asyncio.wait(
+        [run_task, stop_task], return_when=asyncio.FIRST_COMPLETED
+    )
+    run_task.cancel()
+    stop_task.cancel()
+    await node.stop()
+
+
+def cmd_run(args) -> int:
+    from .core.config import NodeConfig
+
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    cfg = NodeConfig.load(args.config)
+    try:
+        asyncio.run(_run_node(cfg, args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_height(args) -> int:
+    from .core.config import NodeConfig
+
+    cfg = NodeConfig.load(args.config)
+    node, _ = _build_node(cfg)
+    print(
+        json.dumps(
+            {
+                "height": node.block_manager.current_height(),
+                "chainId": node.chain_id,
+                "validators": node.public_keys.n,
+            }
+        )
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="lachain-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    kg = sub.add_parser("keygen", help="generate a trusted-dealer devnet")
+    kg.add_argument("--n", type=int, required=True)
+    kg.add_argument("--f", type=int, required=True)
+    kg.add_argument("--out", required=True)
+    kg.add_argument("--host", default="127.0.0.1")
+    kg.add_argument("--port-base", type=int, default=7070)
+    kg.add_argument("--chain-id", type=int, default=225)
+    kg.add_argument("--cycle-duration", type=int, default=1000)
+    kg.add_argument("--vrf-phase", type=int, default=500)
+    kg.add_argument("--initial-balance", type=int, default=10**24)
+    kg.add_argument("--block-time-ms", type=int, default=1000)
+    kg.add_argument(
+        "--fund", nargs="*", help="extra 0x addresses to fund at genesis"
+    )
+    kg.add_argument(
+        "--encrypt", action="store_true", help="password-protect wallets"
+    )
+    kg.set_defaults(fn=cmd_keygen)
+
+    rn = sub.add_parser("run", help="run a node from a config")
+    rn.add_argument("--config", required=True)
+    rn.add_argument("--stake", help="stake this amount at startup")
+    rn.set_defaults(fn=cmd_run)
+
+    ht = sub.add_parser("height", help="print local chain status")
+    ht.add_argument("--config", required=True)
+    ht.set_defaults(fn=cmd_height)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

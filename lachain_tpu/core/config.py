@@ -1,0 +1,192 @@
+"""Node configuration: versioned JSON with sequential schema migrations.
+
+Parity with the reference's ConfigManager
+(/root/reference/src/Lachain.Core/Config/ConfigManager.cs:15-78): a config
+file carries a `version` field; loading runs every migration from the file's
+version up to CURRENT_VERSION in order, so operators can carry configs
+across releases. Typed section accessors replace the reference's section
+classes (NetworkConfig, GenesisConfig, VaultConfig, HardforkConfig...).
+"""
+from __future__ import annotations
+
+import copy
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+CURRENT_VERSION = 3
+
+# -- migrations --------------------------------------------------------------
+# each migrates version N -> N+1 (reference runs 17 of these sequentially)
+
+_MIGRATIONS: Dict[int, Callable[[dict], dict]] = {}
+
+
+def _migration(frm: int):
+    def deco(fn):
+        _MIGRATIONS[frm] = fn
+        return fn
+
+    return deco
+
+
+@_migration(1)
+def _v1_to_v2(cfg: dict) -> dict:
+    # v2 split the flat "port" into a network section
+    net = cfg.setdefault("network", {})
+    if "port" in cfg:
+        net.setdefault("port", cfg.pop("port"))
+    net.setdefault("host", "127.0.0.1")
+    return cfg
+
+
+@_migration(2)
+def _v2_to_v3(cfg: dict) -> dict:
+    # v3 added staking cycle parameters and the hardfork section
+    staking = cfg.setdefault("staking", {})
+    staking.setdefault("cycleDuration", 1000)
+    staking.setdefault("vrfSubmissionPhase", 500)
+    cfg.setdefault("hardfork", {})
+    return cfg
+
+
+def migrate(cfg: dict) -> dict:
+    cfg = copy.deepcopy(cfg)
+    version = int(cfg.get("version", 1))
+    if version > CURRENT_VERSION:
+        raise ValueError(
+            f"config version {version} is newer than supported "
+            f"{CURRENT_VERSION}"
+        )
+    while version < CURRENT_VERSION:
+        step = _MIGRATIONS.get(version)
+        if step is None:
+            raise ValueError(f"no migration from config version {version}")
+        cfg = step(cfg)
+        version += 1
+        cfg["version"] = version
+    return cfg
+
+
+# -- typed sections ----------------------------------------------------------
+
+
+@dataclass
+class NetworkSection:
+    host: str = "127.0.0.1"
+    port: int = 7070
+    # peers: list of "host:port:pubkeyhex"
+    peers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class GenesisSection:
+    chain_id: int = 225
+    balances: Dict[str, str] = field(default_factory=dict)  # hexaddr -> dec
+    # trusted-dealer consensus key set (PublicConsensusKeys.encode() hex) +
+    # this node's validator index (-1 = observer)
+    consensus_keys: str = ""
+    validator_index: int = -1
+
+
+@dataclass
+class VaultSection:
+    path: str = "wallet.json"
+    password: str = ""
+
+
+@dataclass
+class StakingSection:
+    cycle_duration: int = 1000
+    vrf_submission_phase: int = 500
+
+
+@dataclass
+class RpcSection:
+    enabled: bool = True
+    host: str = "127.0.0.1"
+    port: int = 7071
+    api_key: Optional[str] = None
+
+
+@dataclass
+class BlockchainSection:
+    target_txs_per_block: int = 1000
+    target_block_time_ms: int = 1000
+
+
+@dataclass
+class HardforkSection:
+    # name -> activation height (see core/hardforks.py)
+    heights: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class NodeConfig:
+    version: int
+    network: NetworkSection
+    genesis: GenesisSection
+    vault: VaultSection
+    staking: StakingSection
+    rpc: RpcSection
+    blockchain: BlockchainSection
+    hardfork: HardforkSection
+    raw: dict
+
+    @classmethod
+    def from_dict(cls, cfg: dict) -> "NodeConfig":
+        cfg = migrate(cfg)
+        net = cfg.get("network", {})
+        gen = cfg.get("genesis", {})
+        vault = cfg.get("vault", {})
+        staking = cfg.get("staking", {})
+        rpc = cfg.get("rpc", {})
+        bc = cfg.get("blockchain", {})
+        hf = cfg.get("hardfork", {})
+        return cls(
+            version=cfg["version"],
+            network=NetworkSection(
+                host=net.get("host", "127.0.0.1"),
+                port=int(net.get("port", 7070)),
+                peers=list(net.get("peers", [])),
+            ),
+            genesis=GenesisSection(
+                chain_id=int(gen.get("chainId", 225)),
+                balances=dict(gen.get("balances", {})),
+                consensus_keys=gen.get("consensusKeys", ""),
+                validator_index=int(gen.get("validatorIndex", -1)),
+            ),
+            vault=VaultSection(
+                path=vault.get("path", "wallet.json"),
+                password=vault.get("password", ""),
+            ),
+            staking=StakingSection(
+                cycle_duration=int(staking.get("cycleDuration", 1000)),
+                vrf_submission_phase=int(
+                    staking.get("vrfSubmissionPhase", 500)
+                ),
+            ),
+            rpc=RpcSection(
+                enabled=bool(rpc.get("enabled", True)),
+                host=rpc.get("host", "127.0.0.1"),
+                port=int(rpc.get("port", 7071)),
+                api_key=rpc.get("apiKey"),
+            ),
+            blockchain=BlockchainSection(
+                target_txs_per_block=int(bc.get("targetTxsPerBlock", 1000)),
+                target_block_time_ms=int(bc.get("targetBlockTimeMs", 1000)),
+            ),
+            hardfork=HardforkSection(
+                heights={k: int(v) for k, v in hf.get("heights", {}).items()}
+            ),
+            raw=cfg,
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "NodeConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.raw, f, indent=2, sort_keys=True)

@@ -1,0 +1,57 @@
+"""Height-gated hardfork flags.
+
+Parity with the reference's HardforkHeights
+(/root/reference/src/Lachain.Core/Blockchain/Hardfork/HardforkHeights.cs:
+1-164): a fixed set of named protocol changes, each activating at a
+configured block height, set ONCE at process start from the config
+(Application.cs:112-115) and consulted by consensus-critical code paths.
+Every node on a chain must configure identical heights or state hashes
+diverge — exactly the reference's operational contract.
+
+Flags defined so far (heights default to 0 = active from genesis):
+  strict_share_validation  HoneyBadger verifies decryption shares eagerly
+                           below this height and defers to the batched
+                           check above it (reference
+                           _skipDecryptedShareValidation, HoneyBadger.cs:30)
+  boundary_finish_cycle    governance FinishCycle restricted to the cycle's
+                           last block (round-2 rotation alignment rule)
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+_DEFAULTS: Dict[str, int] = {
+    "strict_share_validation": 0,
+    "boundary_finish_cycle": 0,
+}
+
+_heights: Dict[str, int] = dict(_DEFAULTS)
+_frozen = False
+
+
+def set_hardfork_heights(heights: Dict[str, int], *, force: bool = False) -> None:
+    """Install configured activation heights (unknown names rejected).
+    One-shot per process, like the reference's static initialization."""
+    global _frozen
+    if _frozen and not force:
+        raise RuntimeError("hardfork heights already set")
+    for name in heights:
+        if name not in _DEFAULTS:
+            raise ValueError(f"unknown hardfork flag {name!r}")
+    _heights.update(heights)
+    _frozen = True
+
+
+def reset_for_tests() -> None:
+    global _frozen
+    _heights.clear()
+    _heights.update(_DEFAULTS)
+    _frozen = False
+
+
+def is_active(name: str, height: int) -> bool:
+    return height >= _heights[name]
+
+
+def activation_height(name: str) -> int:
+    return _heights[name]

@@ -53,7 +53,6 @@ class KeyGenManager:
         self._keyring: Optional[ThresholdKeyring] = None
         self._cycle: Optional[int] = None
         self._installed_cycles: set = set()
-        self._finish_sent: set = set()
 
     # -- block hook ---------------------------------------------------------
 
@@ -74,24 +73,20 @@ class KeyGenManager:
         self._maybe_finish_cycle(block, snap)
 
     def _maybe_finish_cycle(self, block: Block, snap: Snapshot) -> None:
-        """Once a confirmed rotation is pending, offer the FinishCycle tx so
-        it executes in the cycle's LAST block — the contract rejects it at
-        any other height (reference injects this as a cycle-boundary system
-        tx, BlockProducer.cs:126-146; the contract dedupes concurrent
-        offers)."""
-        # send after block D-2 persists so the tx executes in block D-1,
-        # the only height the contract accepts it at
+        """Once a confirmed rotation is pending, offer the FinishCycle tx
+        after block D-2 persists so it executes in block D-1 — the only
+        height the contract accepts (reference injects this as a
+        cycle-boundary system tx, BlockProducer.cs:126-146). Exactly one
+        block index per cycle satisfies the trigger, so chain state — not a
+        local latch — is the dedupe; a restart or a missed boundary
+        self-heals at the next cycle's window."""
         if (block.header.index + 2) % self._cycle_duration != 0:
-            return
-        cycle = block.header.index // self._cycle_duration
-        if cycle in self._finish_sent:
             return
         pending = self._storage(
             snap, sc.GOVERNANCE_ADDRESS, b"pending_validators"
         )
         if pending is None:
             return
-        self._finish_sent.add(cycle)
         self._send_tx(sc.GOVERNANCE_ADDRESS, sc.SEL_FINISH_CYCLE + b"")
 
     def _handle_event(

@@ -58,6 +58,7 @@ class Node:
         flush_interval: float = 0.02,
         executer: Optional[TransactionExecuter] = None,
         wallet: Optional[PrivateWallet] = None,
+        block_interval: float = 0.0,
     ):
         self.index = index
         self.public_keys = public_keys
@@ -103,6 +104,8 @@ class Node:
         self.router: Optional[EraRouter] = None
         self._era_done = asyncio.Event()
         self._stopping = False
+        # (sender pubkey) -> [(era, payload)]: future-era consensus traffic
+        self._future_msgs: Dict[bytes, list] = {}
         # -- autonomous lifecycle services (reference Application.Start
         #    wiring: KeyGenManager + ValidatorStatusManager hooked on block
         #    persistence; PrivateWallet holds era-keyed threshold keys) -----
@@ -122,6 +125,10 @@ class Node:
         )
         self.block_manager.on_block_persisted.append(self._on_block_persisted)
         self._height_event = asyncio.Event()
+        # target era pacing for the autonomous loop (reference
+        # TargetBlockTime, ConsensusManager.cs:78 — default 5000 ms there;
+        # 0 = as fast as consensus completes, used by tests)
+        self.block_interval = block_interval
 
     # -- service lifecycle --------------------------------------------------
 
@@ -134,8 +141,29 @@ class Node:
             self._ensure_router(first_era)
         self.synchronizer.start()
 
+    async def start_rpc(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        api_key: Optional[str] = None,
+    ):
+        """Expose the Web3-shaped JSON-RPC surface (reference
+        RpcManager.Start, RPC/RpcManager.cs:1-129). Returns the server
+        (its .port reflects the bound port)."""
+        from ..rpc import JsonRpcServer, RpcService
+
+        server = JsonRpcServer(host, port, api_key=api_key)
+        server.register_all(RpcService(self).methods())
+        await server.start()
+        self._rpc_server = server
+        return server
+
     async def stop(self) -> None:
         self._stopping = True
+        self._height_event.set()
+        if getattr(self, "_rpc_server", None) is not None:
+            await self._rpc_server.stop()
+            self._rpc_server = None
         await self.synchronizer.stop()
         await self.network.stop()
 
@@ -194,13 +222,48 @@ class Node:
         self._check_era_done()
 
     def _on_consensus(self, sender_pub: bytes, era: int, payload) -> None:
+        # messages for eras ahead of the local router are stashed at the
+        # NODE level keyed by transport pubkey: the router's own postponed
+        # buffer holds sender INDICES, which become meaningless (and are
+        # discarded) when a rotation swaps the validator set mid-boundary.
+        # HBBFT has no retransmission, so dropping them could cost quorum.
+        if self.router is None or era > self.router.era:
+            self._stash_future(sender_pub, era, payload)
+            return
         sender = self._index_by_pub.get(sender_pub)
         if sender is None:
             logger.warning("consensus message from non-validator dropped")
             return
-        if self.router is None:
-            return
         self.router.dispatch_external(sender, payload)
+        self._check_era_done()
+
+    _FUTURE_STASH_CAP = 2048  # per sender pubkey, across eras
+
+    def _stash_future(self, sender_pub: bytes, era: int, payload) -> None:
+        q = self._future_msgs.setdefault(sender_pub, [])
+        if len(q) >= self._FUTURE_STASH_CAP:
+            return  # spam guard: drop beyond the cap
+        q.append((era, payload))
+
+    def _replay_future(self) -> None:
+        """After the router advances/rebuilds, feed it any stashed messages
+        for its era, re-attributed under the CURRENT index table."""
+        assert self.router is not None
+        era = self.router.era
+        for pub, q in list(self._future_msgs.items()):
+            keep = []
+            sender = self._index_by_pub.get(pub)
+            for msg_era, payload in q:
+                if msg_era < era:
+                    continue  # stale
+                if msg_era == era and sender is not None:
+                    self.router.dispatch_external(sender, payload)
+                else:
+                    keep.append((msg_era, payload))
+            if keep:
+                self._future_msgs[pub] = keep
+            else:
+                self._future_msgs.pop(pub, None)
         self._check_era_done()
 
     def _check_era_done(self) -> None:
@@ -233,6 +296,7 @@ class Node:
             )
         else:
             self.router.advance_era(era)
+        self._replay_future()
         return self.router
 
     async def run_era(
@@ -258,6 +322,8 @@ class Node:
         loop = asyncio.get_running_loop()
         deadline = None if timeout is None else loop.time() + timeout
         while router.result_of(pid) is None:
+            if self._stopping:
+                raise asyncio.CancelledError(f"node stopped during era {era}")
             if self.block_manager.current_height() >= era:
                 block = self.block_manager.block_by_height(era)
                 assert block is not None
@@ -326,10 +392,11 @@ class Node:
         self._height_event.set()
 
     async def _wait_height(self, height: int) -> None:
-        while self.block_manager.current_height() < height:
+        while (
+            not self._stopping
+            and self.block_manager.current_height() < height
+        ):
             self._height_event.clear()
-            if self.block_manager.current_height() >= height:
-                break
             try:
                 await asyncio.wait_for(self._height_event.wait(), timeout=1.0)
             except asyncio.TimeoutError:
@@ -407,8 +474,10 @@ class Node:
         validator set from the era-1 snapshot and the era's keys from the
         wallet, run consensus if a member (sync supersedes a stalled era),
         fire persistence hooks, GC, advance."""
+        loop = asyncio.get_running_loop()
         era = first_era
         while not self._stopping and (stop_at is None or era <= stop_at):
+            era_start = loop.time()
             await self._wait_height(era - 1)
             if self._stopping:
                 return
@@ -417,7 +486,11 @@ class Node:
                 await self._wait_height(era)  # observer for this era
             else:
                 self._rebuild_router(era)
-                await self.run_era(era)
+                await self.run_era(era, timeout=None)
+            if self.block_interval > 0:
+                remaining = self.block_interval - (loop.time() - era_start)
+                if remaining > 0 and not self._stopping:
+                    await asyncio.sleep(remaining)
             era += 1
 
     def _rebuild_router(self, era: int) -> None:

@@ -337,14 +337,35 @@ def _put_winner_list(ctx, cycle: int, winners: List[bytes]) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _is_next_validator(ctx: SystemContractContext) -> bool:
+    """Sender gating for the keygen message board: only addresses of the
+    LOTTERY-ELECTED set may post (reference GovernanceContract keygen
+    methods check the sender against the cycle's validator set,
+    GovernanceContract.cs:117-217). Without this, any funded address could
+    sybil n-f confirms and install an attacker validator set."""
+    from ..crypto import ecdsa as _ecdsa
+
+    nv_raw = ctx.sget(STAKING_ADDRESS, b"next_validators")
+    if not nv_raw:
+        return False
+    for pub in Reader(nv_raw).bytes_list():
+        if _ecdsa.address_from_public_key(pub) == ctx.sender:
+            return True
+    return False
+
+
 def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, bytes]:
     if sel == SEL_KEYGEN_COMMIT:
+        if not _is_next_validator(ctx):
+            return 0, b""
         blob = args.bytes_()
         key = b"commit:" + write_u64(ctx.block // CYCLE_DURATION) + ctx.sender
         ctx.sput(GOVERNANCE_ADDRESS, key, blob)
         ctx.emit(GOVERNANCE_ADDRESS, b"keygen_commit" + ctx.sender + blob)
         return 1, b""
     if sel == SEL_KEYGEN_SEND_VALUE:
+        if not _is_next_validator(ctx):
+            return 0, b""
         round_no = args.u256()
         blob = args.bytes_()
         key = (
@@ -357,6 +378,8 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
         ctx.emit(GOVERNANCE_ADDRESS, b"keygen_value" + ctx.sender + blob)
         return 1, b""
     if sel == SEL_KEYGEN_CONFIRM:
+        if not _is_next_validator(ctx):
+            return 0, b""
         blob = args.bytes_()  # serialized new public key set
         cycle = ctx.block // CYCLE_DURATION
         h = keccak256(blob)
@@ -383,10 +406,12 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
                 ctx.emit(GOVERNANCE_ADDRESS, b"validators_changed" + h)
         return 1, write_u32(len(voters))
     if sel == SEL_CHANGE_VALIDATORS:
-        blob = args.bytes_()
-        ctx.sput(GOVERNANCE_ADDRESS, b"pending_validators", blob)
-        ctx.emit(GOVERNANCE_ADDRESS, b"change_validators")
-        return 1, b""
+        # In the reference this is an internal transition invoked by the
+        # confirm threshold (GovernanceContract.cs:283-331), never a public
+        # entry point; exposing it lets one funded address install an
+        # arbitrary validator set. The only path to pending_validators is
+        # the n-f keygen-confirm quorum above.
+        return 0, b""
     if sel == SEL_FINISH_CYCLE:
         # only the cycle's LAST block may rotate the set: the new keys are
         # wallet-installed from era (cycle+1)*CYCLE_DURATION, so the

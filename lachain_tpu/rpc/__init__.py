@@ -1,0 +1,5 @@
+"""JSON-RPC layer (reference: src/Lachain.Core/RPC)."""
+from .http import JsonRpcError, JsonRpcServer
+from .service import RpcService
+
+__all__ = ["JsonRpcError", "JsonRpcServer", "RpcService"]

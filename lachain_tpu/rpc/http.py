@@ -1,0 +1,173 @@
+"""Minimal asyncio JSON-RPC 2.0 HTTP endpoint.
+
+The role of the reference's HttpService (HttpListener + AustinHarris.JsonRpc,
+/root/reference/src/Lachain.Core/RPC/HTTP/HttpService.cs:17-96): one POST
+endpoint, optional x-api-key check, JSON-RPC batch support. Implemented
+directly on asyncio streams — the framework keeps zero HTTP dependencies.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY = 4 << 20
+
+
+class JsonRpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class JsonRpcServer:
+    """Dispatches JSON-RPC 2.0 requests to registered methods."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        api_key: Optional[str] = None,
+    ):
+        self.host = host
+        self.port = port
+        self.api_key = api_key
+        self._methods: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    def register_all(self, mapping: Dict[str, Callable]) -> None:
+        self._methods.update(mapping)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("JSON-RPC listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- HTTP plumbing ------------------------------------------------------
+
+    async def _handle_conn(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    method, _path, _ver = line.decode().split(" ", 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", "0"))
+                if length > MAX_BODY:
+                    await self._respond(writer, 413, b"body too large")
+                    return
+                body = await reader.readexactly(length) if length else b""
+                if method.upper() != "POST":
+                    await self._respond(writer, 405, b"POST only")
+                    continue
+                if self.api_key is not None and headers.get(
+                    "x-api-key"
+                ) != self.api_key:
+                    await self._respond(writer, 403, b"bad api key")
+                    continue
+                payload = await self._process(body)
+                await self._respond(
+                    writer, 200, payload, ctype="application/json"
+                )
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            logger.exception("rpc connection handler failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _respond(writer, status, body: bytes, ctype="text/plain"):
+        reason = {200: "OK", 403: "Forbidden", 405: "Method Not Allowed",
+                  413: "Payload Too Large"}.get(status, "?")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "\r\n".encode() + body
+        )
+        await writer.drain()
+
+    # -- JSON-RPC semantics --------------------------------------------------
+
+    async def _process(self, body: bytes) -> bytes:
+        try:
+            req = json.loads(body)
+        except Exception:
+            return json.dumps(
+                _err(None, -32700, "parse error")
+            ).encode()
+        if isinstance(req, list):
+            out = [await self._one(r) for r in req]
+            out = [r for r in out if r is not None]
+            return json.dumps(out).encode()
+        res = await self._one(req)
+        return json.dumps(res if res is not None else {}).encode()
+
+    async def _one(self, req) -> Optional[dict]:
+        if not isinstance(req, dict):
+            return _err(None, -32600, "invalid request")
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params", [])
+        fn = self._methods.get(method)
+        if fn is None:
+            return _err(rid, -32601, f"method {method!r} not found")
+        try:
+            if isinstance(params, dict):
+                result = fn(**params)
+            else:
+                result = fn(*params)
+            if asyncio.iscoroutine(result):
+                result = await result
+        except JsonRpcError as e:
+            return _err(rid, e.code, e.message)
+        except TypeError as e:
+            return _err(rid, -32602, f"invalid params: {e}")
+        except Exception as e:
+            logger.exception("rpc method %s failed", method)
+            return _err(rid, -32603, f"internal error: {e}")
+        if rid is None:
+            return None  # notification
+        return {"jsonrpc": "2.0", "id": rid, "result": result}
+
+
+def _err(rid, code, message) -> dict:
+    return {
+        "jsonrpc": "2.0",
+        "id": rid,
+        "error": {"code": code, "message": message},
+    }

@@ -1,0 +1,352 @@
+"""Web3-shaped JSON-RPC surface over a running node.
+
+Parity with the reference's RPC services
+(/root/reference/src/Lachain.Core/RPC/HTTP/Web3/BlockchainServiceWeb3.cs:
+1-827, TransactionServiceWeb3.cs:1-831, AccountServiceWeb3.cs:1-232,
+ValidatorServiceWeb3.cs:1-162, NodeService.cs:1-183): the eth_* core an
+external client needs to follow the chain, submit transactions and read
+receipts/logs, plus la_/validator_ status methods. Transactions ride the
+framework's own fixed-width wire format (SignedTransaction.encode() hex),
+not RLP — the chain defines its own encoding (SURVEY.md §7 hard-part #2).
+"""
+from __future__ import annotations
+
+import binascii
+from typing import Any, Dict, List, Optional
+
+from .. import __name__ as _pkg
+from ..core import execution
+from ..core.types import Block, SignedTransaction, TransactionReceipt
+from ..crypto import ecdsa
+from ..utils.serialization import write_u32
+from ..vm import vm as wasm_vm
+from .http import JsonRpcError
+
+
+def _hex(v: int) -> str:
+    return hex(v)
+
+
+def _unhex(v) -> int:
+    if isinstance(v, str):
+        return int(v, 16) if v.startswith("0x") else int(v)
+    return int(v)
+
+
+def _h(data: bytes) -> str:
+    return "0x" + data.hex()
+
+def _bytes(v: str) -> bytes:
+    if not isinstance(v, str) or not v.startswith("0x"):
+        raise JsonRpcError(-32602, "expected 0x-prefixed hex")
+    try:
+        return bytes.fromhex(v[2:])
+    except (ValueError, binascii.Error):
+        raise JsonRpcError(-32602, "bad hex")
+
+
+class RpcService:
+    """Builds the method table for a Node (core/node.py)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # -- helpers ------------------------------------------------------------
+
+    def _snap(self):
+        return self.node.state.new_snapshot()
+
+    def _resolve_block(self, tag) -> Optional[Block]:
+        bm = self.node.block_manager
+        if tag in ("latest", "pending", None):
+            return bm.block_by_height(bm.current_height())
+        if tag == "earliest":
+            return bm.block_by_height(0)
+        return bm.block_by_height(_unhex(tag))
+
+    def _block_json(self, block: Block, full_txs: bool) -> dict:
+        h = block.header
+        txs: List[Any]
+        if full_txs:
+            txs = []
+            for i, th in enumerate(block.tx_hashes):
+                stx = self.node.block_manager.transaction_by_hash(th)
+                if stx is not None:
+                    txs.append(self._tx_json(stx, block, i))
+        else:
+            txs = [_h(t) for t in block.tx_hashes]
+        return {
+            "number": _hex(h.index),
+            "hash": _h(block.hash()),
+            "parentHash": _h(h.prev_block_hash),
+            "stateRoot": _h(h.state_hash),
+            "transactionsRoot": _h(h.merkle_root),
+            "nonce": _hex(h.nonce),
+            "transactions": txs,
+            "signatureCount": len(block.multisig.signatures),
+        }
+
+    def _tx_json(
+        self, stx: SignedTransaction, block: Optional[Block], index: int
+    ) -> dict:
+        tx = stx.tx
+        sender = stx.sender(self.node.chain_id)
+        return {
+            "hash": _h(stx.hash()),
+            "from": _h(sender) if sender else None,
+            "to": _h(tx.to),
+            "value": _hex(tx.value),
+            "nonce": _hex(tx.nonce),
+            "gasPrice": _hex(tx.gas_price),
+            "gas": _hex(tx.gas_limit),
+            "input": _h(tx.invocation),
+            "blockNumber": _hex(block.header.index) if block else None,
+            "blockHash": _h(block.hash()) if block else None,
+            "transactionIndex": _hex(index) if block else None,
+            "raw": _h(stx.encode()),
+        }
+
+    # -- eth_* --------------------------------------------------------------
+
+    def eth_chainId(self):
+        return _hex(self.node.chain_id)
+
+    def eth_blockNumber(self):
+        return _hex(self.node.block_manager.current_height())
+
+    def eth_getBlockByNumber(self, tag, full=False):
+        block = self._resolve_block(tag)
+        return self._block_json(block, bool(full)) if block else None
+
+    def eth_getBlockByHash(self, block_hash, full=False):
+        block = self.node.block_manager.block_by_hash(_bytes(block_hash))
+        return self._block_json(block, bool(full)) if block else None
+
+    def eth_getTransactionByHash(self, tx_hash):
+        h = _bytes(tx_hash)
+        stx = self.node.block_manager.transaction_by_hash(h)
+        if stx is None:
+            pooled = self.node.pool.get(h)
+            return self._tx_json(pooled, None, 0) if pooled else None
+        raw = self.node.block_manager.receipt_by_hash(h)
+        block = None
+        index = 0
+        if raw:
+            rec = TransactionReceipt.decode(raw)
+            block = self.node.block_manager.block_by_height(rec.block_index)
+            index = rec.index_in_block
+        return self._tx_json(stx, block, index)
+
+    def eth_getTransactionReceipt(self, tx_hash):
+        h = _bytes(tx_hash)
+        raw = self.node.block_manager.receipt_by_hash(h)
+        if raw is None:
+            return None
+        rec = TransactionReceipt.decode(raw)
+        block = self.node.block_manager.block_by_height(rec.block_index)
+        return {
+            "transactionHash": _h(rec.tx_hash),
+            "blockNumber": _hex(rec.block_index),
+            "blockHash": _h(block.hash()) if block else None,
+            "transactionIndex": _hex(rec.index_in_block),
+            "from": _h(rec.sender),
+            "gasUsed": _hex(rec.gas_used),
+            "status": _hex(rec.status),
+            "contractAddress": _h(rec.return_data)
+            if len(rec.return_data) == 20
+            else None,
+            "returnData": _h(rec.return_data),
+            "logs": self._logs_for_tx(rec.tx_hash),
+        }
+
+    def eth_sendRawTransaction(self, raw):
+        try:
+            stx = SignedTransaction.decode(_bytes(raw))
+        except Exception:
+            raise JsonRpcError(-32602, "undecodable transaction")
+        if not self.node.submit_tx(stx):
+            raise JsonRpcError(-32000, "transaction rejected by pool")
+        return _h(stx.hash())
+
+    def eth_getBalance(self, address, tag="latest"):
+        return _hex(
+            execution.get_balance(self._snap(), _bytes(address))
+        )
+
+    def eth_getTransactionCount(self, address, tag="latest"):
+        return _hex(execution.get_nonce(self._snap(), _bytes(address)))
+
+    def eth_getCode(self, address, tag="latest"):
+        code = wasm_vm.get_code(self._snap(), _bytes(address))
+        return _h(code) if code else "0x"
+
+    def eth_getStorageAt(self, address, key, tag="latest"):
+        raw = self._snap().get("storage", _bytes(address) + _bytes(key))
+        return _h(raw) if raw else "0x"
+
+    def eth_call(self, call, tag="latest"):
+        """Read-only contract execution against the committed state."""
+        to = _bytes(call.get("to", "0x"))
+        data = _bytes(call.get("data", call.get("input", "0x")))
+        sender = _bytes(call.get("from", "0x" + "00" * 20))
+        snap = self._snap()
+        if wasm_vm.get_code(snap, to) is None:
+            return "0x"
+        machine = wasm_vm.VirtualMachine(
+            snap,
+            block_index=self.node.block_manager.current_height(),
+            origin=sender,
+            gas_price=1,
+            chain_id=self.node.chain_id,
+        )
+        res = machine.invoke_contract(
+            contract=to,
+            sender=sender,
+            value=0,
+            input=data,
+            gas_limit=10**9,
+            static=True,
+        )
+        if res.status != 1:
+            raise JsonRpcError(-32015, "execution reverted")
+        return _h(res.return_data)
+
+    def eth_estimateGas(self, call=None, tag="latest"):
+        return _hex(execution.GAS_PER_TX)
+
+    def eth_gasPrice(self):
+        return _hex(1)
+
+    def eth_syncing(self):
+        heights = self.node.synchronizer.peer_heights.values()
+        best = max(heights) if heights else 0
+        mine = self.node.block_manager.current_height()
+        if best <= mine:
+            return False
+        return {
+            "currentBlock": _hex(mine),
+            "highestBlock": _hex(best),
+        }
+
+    def eth_accounts(self):
+        return [_h(self.node.address20)]
+
+    def eth_getLogs(self, flt=None):
+        flt = flt or {}
+        bm = self.node.block_manager
+        frm = (
+            _unhex(flt["fromBlock"])
+            if flt.get("fromBlock") not in (None, "latest")
+            else bm.current_height()
+        )
+        to = (
+            _unhex(flt["toBlock"])
+            if flt.get("toBlock") not in (None, "latest")
+            else bm.current_height()
+        )
+        to = min(to, bm.current_height())
+        if to - frm > 1000:
+            raise JsonRpcError(-32005, "block range too wide (max 1000)")
+        want_addr = (
+            _bytes(flt["address"]) if flt.get("address") else None
+        )
+        out = []
+        for height in range(frm, to + 1):
+            block = bm.block_by_height(height)
+            if block is None:
+                continue
+            for th in block.tx_hashes:
+                out.extend(
+                    log
+                    for log in self._logs_for_tx(th, block)
+                    if want_addr is None
+                    or _bytes(log["address"]) == want_addr
+                )
+        return out
+
+    def _logs_for_tx(self, tx_hash: bytes, block=None) -> List[dict]:
+        snap = self._snap()
+        out = []
+        i = 0
+        while True:
+            raw = snap.get("events", tx_hash + write_u32(i))
+            if raw is None:
+                break
+            out.append(
+                {
+                    "address": _h(raw[:20]),
+                    "data": _h(raw[20:]),
+                    "transactionHash": _h(tx_hash),
+                    "logIndex": _hex(i),
+                    "blockNumber": _hex(block.header.index)
+                    if block
+                    else None,
+                }
+            )
+            i += 1
+        return out
+
+    # -- net_* / web3_* ------------------------------------------------------
+
+    def net_version(self):
+        return str(self.node.chain_id)
+
+    def net_peerCount(self):
+        return _hex(len(self.node.synchronizer.peer_heights))
+
+    def web3_clientVersion(self):
+        return "lachain-tpu/0.2"
+
+    # -- la_* / validator_* --------------------------------------------------
+
+    def la_consensusState(self):
+        keys = self.node.public_keys
+        return {
+            "era": self.node.router.era if self.node.router else None,
+            "n": keys.n,
+            "f": keys.f,
+            "validators": [_h(pk) for pk in keys.ecdsa_pub_keys],
+            "tpkePublicKey": _h(keys.tpke_pub.to_bytes()),
+            "myIndex": self.node.index,
+        }
+
+    def la_validatorInfo(self, address=None):
+        addr = _bytes(address) if address else self.node.address20
+        snap = self._snap()
+        from ..core import system_contracts as sc
+
+        stake_raw = snap.get("storage", sc.STAKING_ADDRESS + b"stake:" + addr)
+        stake = int.from_bytes(stake_raw, "big") if stake_raw else 0
+        in_set = False
+        try:
+            pub = next(
+                pk
+                for pk in self.node.public_keys.ecdsa_pub_keys
+                if ecdsa.address_from_public_key(pk) == addr
+            )
+            in_set = True
+        except StopIteration:
+            pub = None
+        return {
+            "address": _h(addr),
+            "stake": _hex(stake),
+            "isValidator": in_set,
+            "publicKey": _h(pub) if pub else None,
+        }
+
+    def validator_status(self):
+        vsm = self.node.validator_status
+        return {
+            "isValidator": self.node.index >= 0,
+            "stake": _hex(vsm.stake_of(self._snap())),
+            "withdrawRequested": vsm.withdraw_requested,
+        }
+
+    # -- registry ------------------------------------------------------------
+
+    def methods(self) -> Dict[str, Any]:
+        out = {}
+        for name in dir(self):
+            if name.startswith(("eth_", "net_", "web3_", "la_", "validator_")):
+                out[name] = getattr(self, name)
+        return out

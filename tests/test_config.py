@@ -1,0 +1,50 @@
+"""Config migrations + hardfork flag system (reference ConfigManager.cs
+sequential migrations, HardforkHeights.cs height gates)."""
+import pytest
+
+from lachain_tpu.core import hardforks
+from lachain_tpu.core.config import CURRENT_VERSION, NodeConfig, migrate
+
+
+def test_v1_config_migrates_all_the_way():
+    cfg = NodeConfig.from_dict({"version": 1, "port": 9999})
+    assert cfg.version == CURRENT_VERSION
+    assert cfg.network.port == 9999
+    assert cfg.staking.cycle_duration == 1000  # v3 default materialized
+
+
+def test_newer_version_rejected():
+    with pytest.raises(ValueError):
+        migrate({"version": CURRENT_VERSION + 1})
+
+
+def test_sections_parse_and_roundtrip(tmp_path):
+    raw = {
+        "version": CURRENT_VERSION,
+        "network": {"host": "0.0.0.0", "port": 7070, "peers": ["a:1:00"]},
+        "genesis": {"chainId": 97, "balances": {"0x" + "11" * 20: "5"}},
+        "rpc": {"port": 7071, "apiKey": "sekrit"},
+        "blockchain": {"targetBlockTimeMs": 250},
+    }
+    cfg = NodeConfig.from_dict(raw)
+    assert cfg.genesis.chain_id == 97
+    assert cfg.rpc.api_key == "sekrit"
+    assert cfg.blockchain.target_block_time_ms == 250
+    p = tmp_path / "c.json"
+    cfg.save(str(p))
+    again = NodeConfig.load(str(p))
+    assert again.network.peers == ["a:1:00"]
+
+
+def test_hardfork_flags():
+    hardforks.reset_for_tests()
+    try:
+        hardforks.set_hardfork_heights({"strict_share_validation": 100})
+        assert not hardforks.is_active("strict_share_validation", 99)
+        assert hardforks.is_active("strict_share_validation", 100)
+        with pytest.raises(RuntimeError):
+            hardforks.set_hardfork_heights({})  # one-shot
+        with pytest.raises(ValueError):
+            hardforks.set_hardfork_heights({"bogus": 1}, force=True)
+    finally:
+        hardforks.reset_for_tests()
