@@ -69,6 +69,10 @@ def cmd_keygen(args) -> int:
         )
         wallet.add_threshold_keys(0, privs[i].tpke_priv, privs[i].ts_share)
         wallet.save()
+        if password:
+            # never written to the config: hand it to the operator once;
+            # `run` reads LACHAIN_WALLET_PASSWORD at startup
+            print(f"wallet{i} password: {password}", file=sys.stderr)
         cfg = {
             "version": CURRENT_VERSION,
             "network": {
@@ -82,7 +86,7 @@ def cmd_keygen(args) -> int:
                 "consensusKeys": consensus_hex,
                 "validatorIndex": i,
             },
-            "vault": {"path": wallet_path, "password": password},
+            "vault": {"path": wallet_path, "password": ""},
             "staking": {
                 "cycleDuration": args.cycle_duration,
                 "vrfSubmissionPhase": args.vrf_phase,
@@ -108,20 +112,24 @@ def cmd_keygen(args) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _build_node(cfg, db_path=None):
+def _build_node(cfg, config_path=None):
     from .consensus.keys import PrivateConsensusKeys, PublicConsensusKeys
     from .core import system_contracts as sc
     from .core.hardforks import set_hardfork_heights
     from .core.node import Node
     from .core.vault import PrivateWallet
     from .network.hub import PeerAddress
+    from .storage.kv import SqliteKV
 
     sc.set_cycle_params(
         cfg.staking.cycle_duration, cfg.staking.vrf_submission_phase
     )
     if cfg.hardfork.heights:
         set_hardfork_heights(cfg.hardfork.heights, force=True)
-    wallet = PrivateWallet.load(cfg.vault.path, cfg.vault.password)
+    password = cfg.vault.password or os.environ.get(
+        "LACHAIN_WALLET_PASSWORD", ""
+    )
+    wallet = PrivateWallet.load(cfg.vault.path, password)
     pub = PublicConsensusKeys.decode(bytes.fromhex(cfg.genesis.consensus_keys))
     idx = cfg.genesis.validator_index
     priv = wallet.consensus_keys_for_era(0)
@@ -131,11 +139,15 @@ def _build_node(cfg, db_path=None):
     balances = {
         bytes.fromhex(a[2:]): int(v) for a, v in cfg.genesis.balances.items()
     }
+    db_path = cfg.storage_path
+    if db_path is None and config_path is not None:
+        db_path = os.path.splitext(config_path)[0] + ".db"
     node = Node(
         index=idx,
         public_keys=pub,
         private_keys=priv,
         chain_id=cfg.genesis.chain_id,
+        kv=SqliteKV(db_path) if db_path else None,
         host=cfg.network.host,
         port=cfg.network.port,
         initial_balances=balances,
@@ -155,7 +167,7 @@ def _build_node(cfg, db_path=None):
 
 
 async def _run_node(cfg, args) -> None:
-    node, peers = _build_node(cfg)
+    node, peers = _build_node(cfg, args.config)
     await node.start()
     node.connect(peers)
     rpc = None
@@ -186,9 +198,16 @@ async def _run_node(cfg, args) -> None:
     await asyncio.wait(
         [run_task, stop_task], return_when=asyncio.FIRST_COMPLETED
     )
+    failure = None
+    if run_task.done() and not run_task.cancelled():
+        failure = run_task.exception()
     run_task.cancel()
     stop_task.cancel()
     await node.stop()
+    if failure is not None:
+        # surface the lifecycle crash: the process must exit non-zero so
+        # supervisors restart it, not report success
+        raise failure
 
 
 def cmd_run(args) -> int:
@@ -210,7 +229,7 @@ def cmd_height(args) -> int:
     from .core.config import NodeConfig
 
     cfg = NodeConfig.load(args.config)
-    node, _ = _build_node(cfg)
+    node, _ = _build_node(cfg, args.config)
     print(
         json.dumps(
             {

@@ -66,6 +66,13 @@ class Protocol:
         self.result: Any = None
         self._result_emitted = False
         self._parent: Optional[Any] = None
+        # liveness breadcrumbs for the 60s stall watchdog (reference
+        # AbstractProtocol._lastMessage, AbstractProtocol.cs:36-38, 113-135)
+        import time as _time
+
+        self.started_at = _time.monotonic()
+        self.last_activity = self.started_at
+        self.last_message: str = "<created>"
 
     # -- runtime ------------------------------------------------------------
     def receive(self, envelope) -> None:
@@ -73,6 +80,17 @@ class Protocol:
         protocol (reference: AbstractProtocol.cs:137-146)."""
         if self.terminated:
             return
+        import time as _time
+
+        from ..utils import metrics
+
+        metrics.inc("consensus_messages_processed")
+        self.last_activity = _time.monotonic()
+        self.last_message = type(envelope).__name__ + (
+            f":{type(envelope.payload).__name__}"
+            if isinstance(envelope, M.External)
+            else ""
+        )
         try:
             if isinstance(envelope, M.External):
                 self.handle_external(envelope.sender, envelope.payload)

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..crypto.hashes import keccak256
 from ..storage.kv import EntryPrefix, KVStore, prefixed
 from ..storage.state import Snapshot, StateManager, StateRoots
+from ..utils import metrics
 from ..utils.serialization import write_u64
 from .execution import TransactionExecuter, set_balance
 from .types import (
@@ -95,21 +96,26 @@ class BlockManager:
         multisig: MultiSig,
         check_state_hash: bool = True,
     ) -> Block:
-        txs = self.order_transactions(txs, self.executer.chain_id)
-        em = self.emulate(txs, header.index)
-        if check_state_hash and em.state_hash != header.state_hash:
-            raise ValueError(
-                f"state hash mismatch at block {header.index}: "
-                f"{em.state_hash.hex()} != {header.state_hash.hex()}"
+        # block exec metrics (reference Prometheus summaries,
+        # BlockManager.cs:62-127)
+        with metrics.measure("block_execute"):
+            txs = self.order_transactions(txs, self.executer.chain_id)
+            em = self.emulate(txs, header.index)
+            if check_state_hash and em.state_hash != header.state_hash:
+                raise ValueError(
+                    f"state hash mismatch at block {header.index}: "
+                    f"{em.state_hash.hex()} != {header.state_hash.hex()}"
+                )
+            if tx_merkle_root([t.hash() for t in txs]) != header.merkle_root:
+                raise ValueError("tx merkle root mismatch")
+            block = Block(
+                header=header,
+                tx_hashes=tuple(t.hash() for t in txs),
+                multisig=multisig,
             )
-        if tx_merkle_root([t.hash() for t in txs]) != header.merkle_root:
-            raise ValueError("tx merkle root mismatch")
-        block = Block(
-            header=header,
-            tx_hashes=tuple(t.hash() for t in txs),
-            multisig=multisig,
-        )
-        self._persist(block, txs, em)
+            self._persist(block, txs, em)
+        metrics.set_gauge("chain_height", block.header.index)
+        metrics.inc("chain_txs_total", len(txs))
         return block
 
     def _persist(self, block: Block, txs, em: EmulationResult) -> None:

@@ -133,6 +133,10 @@ class NodeConfig:
     hardfork: HardforkSection
     raw: dict
 
+    @property
+    def storage_path(self) -> Optional[str]:
+        return self.raw.get("storage", {}).get("path")
+
     @classmethod
     def from_dict(cls, cfg: dict) -> "NodeConfig":
         cfg = migrate(cfg)

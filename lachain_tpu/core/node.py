@@ -140,6 +140,36 @@ class Node:
         if self.index >= 0:
             self._ensure_router(first_era)
         self.synchronizer.start()
+        self._watchdog_task = asyncio.get_running_loop().create_task(
+            self._protocol_watchdog()
+        )
+
+    async def _protocol_watchdog(self) -> None:
+        """60s protocol stall watchdog with last-message breadcrumb
+        (reference AbstractProtocol 'taking too long' warnings,
+        AbstractProtocol.cs:113-135)."""
+        import time as _time
+
+        while not self._stopping:
+            await asyncio.sleep(10.0)
+            router = self.router
+            if router is None:
+                continue
+            now = _time.monotonic()
+            for pid, proto in list(router._protocols.items()):
+                if proto.terminated or proto.result is not None:
+                    continue
+                stalled = now - proto.last_activity
+                if stalled > 60.0:
+                    logger.warning(
+                        "protocol %s stalled for %.0fs (alive %.0fs, "
+                        "last message: %s)",
+                        pid,
+                        stalled,
+                        now - proto.started_at,
+                        proto.last_message,
+                    )
+                    proto.last_activity = now  # re-arm, don't spam
 
     async def start_rpc(
         self,
@@ -161,6 +191,9 @@ class Node:
     async def stop(self) -> None:
         self._stopping = True
         self._height_event.set()
+        if getattr(self, "_watchdog_task", None) is not None:
+            self._watchdog_task.cancel()
+            self._watchdog_task = None
         if getattr(self, "_rpc_server", None) is not None:
             await self._rpc_server.stop()
             self._rpc_server = None
@@ -237,17 +270,28 @@ class Node:
         self.router.dispatch_external(sender, payload)
         self._check_era_done()
 
-    _FUTURE_STASH_CAP = 2048  # per sender pubkey, across eras
+    _FUTURE_STASH_CAP = 512  # per sender pubkey, across eras
+    _FUTURE_STASH_SENDERS = 64  # distinct pubkeys (spam/memory bound)
+    _FUTURE_STASH_HORIZON = 16  # eras ahead worth keeping
 
     def _stash_future(self, sender_pub: bytes, era: int, payload) -> None:
-        q = self._future_msgs.setdefault(sender_pub, [])
+        cur = self.router.era if self.router is not None else 0
+        if era > cur + self._FUTURE_STASH_HORIZON:
+            return  # absurdly far ahead: spam
+        q = self._future_msgs.get(sender_pub)
+        if q is None:
+            if len(self._future_msgs) >= self._FUTURE_STASH_SENDERS:
+                return  # bound the number of distinct (possibly fake) peers
+            q = self._future_msgs.setdefault(sender_pub, [])
         if len(q) >= self._FUTURE_STASH_CAP:
-            return  # spam guard: drop beyond the cap
+            return
         q.append((era, payload))
 
     def _replay_future(self) -> None:
         """After the router advances/rebuilds, feed it any stashed messages
-        for its era, re-attributed under the CURRENT index table."""
+        for its era, re-attributed under the CURRENT index table; prune
+        everything at or below the current era so entries from senders that
+        never become validators cannot accumulate."""
         assert self.router is not None
         era = self.router.era
         for pub, q in list(self._future_msgs.items()):
@@ -256,10 +300,11 @@ class Node:
             for msg_era, payload in q:
                 if msg_era < era:
                     continue  # stale
-                if msg_era == era and sender is not None:
-                    self.router.dispatch_external(sender, payload)
-                else:
-                    keep.append((msg_era, payload))
+                if msg_era == era:
+                    if sender is not None:
+                        self.router.dispatch_external(sender, payload)
+                    continue  # current-era traffic never outlives this call
+                keep.append((msg_era, payload))
             if keep:
                 self._future_msgs[pub] = keep
             else:
@@ -487,11 +532,23 @@ class Node:
             else:
                 self._rebuild_router(era)
                 await self.run_era(era, timeout=None)
+            self._finish_era_metrics(era)
             if self.block_interval > 0:
                 remaining = self.block_interval - (loop.time() - era_start)
                 if remaining > 0 and not self._stopping:
                     await asyncio.sleep(remaining)
             era += 1
+
+    def _finish_era_metrics(self, era: int) -> None:
+        """Per-era crypto counter dump + reset (reference FinishEra ->
+        DefaultCrypto.ResetBenchmark, ConsensusManager.cs:178,
+        DefaultCrypto.cs:47-69)."""
+        from ..utils import metrics
+
+        snap = metrics.timer_snapshot(reset=True)
+        crypto = {k: v for k, v in snap.items() if k.startswith("crypto_")}
+        if crypto:
+            logger.info("era %d crypto benchmark: %s", era, crypto)
 
     def _rebuild_router(self, era: int) -> None:
         """Router for `era` under the CURRENT key set. Unlike

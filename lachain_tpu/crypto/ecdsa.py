@@ -60,6 +60,8 @@ def _mul(p: Optional[Tuple[int, int]], k: int):
     return result
 
 
+from ..utils import metrics
+
 def generate_private_key(rng=None) -> bytes:
     import secrets as _secrets
 
@@ -121,6 +123,7 @@ def _rfc6979_k(priv: bytes, msg_hash: bytes) -> int:
         holder = hmac.new(key, holder, hashlib.sha256).digest()
 
 
+@metrics.timed("crypto_ec_sign")
 def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
     """65-byte recoverable signature r(32) || s(32) || v(1), low-s enforced."""
     assert len(msg_hash) == 32
@@ -147,6 +150,7 @@ def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
         return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
 
 
+@metrics.timed("crypto_ec_verify")
 def verify_hash(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
     if len(sig) != 65:
         return False
@@ -215,6 +219,7 @@ def ecies_decrypt(priv: bytes, data: bytes) -> bytes:
     return aes_gcm_decrypt(key, data[33:])
 
 
+@metrics.timed("crypto_ec_recover")
 def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     """Recover the compressed public key from a 65-byte signature."""
     if len(sig) != 65:

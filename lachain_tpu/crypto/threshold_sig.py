@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import bls12381 as bls
+from ..utils import metrics
 from .hashes import keccak256
 from .provider import batch_bisect_verify, get_backend, select_distinct
 
@@ -94,6 +95,7 @@ class TsPublicKey:
     def from_bytes(cls, data: bytes) -> "TsPublicKey":
         return cls(get_backend().g1_deserialize(data))
 
+    @metrics.timed("crypto_ts_verify")
     def verify(self, msg: bytes, sig: Signature) -> bool:
         """e(g1, sigma) == e(Y, H_G2(msg))
         (reference: ThresholdSignature/PublicKey.cs:15-20)."""
@@ -137,6 +139,7 @@ class TsPublicKeySet:
     def n(self) -> int:
         return len(self.keys)
 
+    @metrics.timed("crypto_ts_verify_share")
     def verify_share(self, msg: bytes, ps: PartialSignature) -> bool:
         """e(g1, sigma_i) == e(Y_i, H(msg)) — per-share hot op
         (reference: ThresholdSigner.cs:92-95)."""
@@ -186,6 +189,7 @@ class TsPublicKeySet:
             results[i] = live_results[pos]
         return results
 
+    @metrics.timed("crypto_ts_combine")
     def combine(self, shares: Sequence[PartialSignature]) -> Signature:
         """Lagrange-assemble t+1 partial signatures in G2
         (reference: PublicKeySet.cs:35-44)."""
@@ -230,6 +234,7 @@ class TsPrivateKeyShare:
     def public_key(self) -> TsPublicKey:
         return TsPublicKey(bls.g1_mul(bls.G1_GEN, self.x_i))
 
+    @metrics.timed("crypto_ts_sign")
     def sign(self, msg: bytes) -> PartialSignature:
         """sigma_i = H_G2(msg)^{x_i}
         (reference: PrivateKeyShare.cs:20-27 HashAndSign)."""

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from . import bls12381 as bls
 from .hashes import xof
 from .provider import batch_bisect_verify, get_backend, select_distinct
+from ..utils import metrics
 
 _ENC_DOMAIN = b"LTPU-TPKE-PAD"
 _HW_DOMAIN = b"LTPU-TPKE-W"
@@ -141,6 +142,7 @@ class TpkePublicKey:
         return cls(y, t)
 
     # -- encryption ----------------------------------------------------------
+    @metrics.timed("crypto_tpke_encrypt")
     def encrypt(self, msg: bytes, share_id: int, rng=secrets) -> EncryptedShare:
         backend = get_backend()
         r = rng.randbelow(bls.R - 1) + 1
@@ -172,6 +174,7 @@ class TpkePublicKey:
             [(dec.ui, h), (bls.g1_neg(vk.y_i), share.w)]
         )
 
+    @metrics.timed("crypto_tpke_verify_shares")
     def batch_verify_shares(
         self,
         vks: Sequence["TpkeVerificationKey"],
@@ -204,6 +207,7 @@ class TpkePublicKey:
         return batch_bisect_verify(group_ok, len(decs))
 
     # -- combination ---------------------------------------------------------
+    @metrics.timed("crypto_tpke_full_decrypt")
     def full_decrypt(
         self,
         share: EncryptedShare,
@@ -264,6 +268,7 @@ class TpkePrivateKey:
         r.assert_eof()
         return cls(x, my_id)
 
+    @metrics.timed("crypto_tpke_part_decrypt")
     def decrypt_share(
         self, share: EncryptedShare, check: bool = True
     ) -> PartiallyDecryptedShare:

@@ -71,7 +71,7 @@ class JsonRpcServer:
                     return
                 try:
                     method, _path, _ver = line.decode().split(" ", 2)
-                except ValueError:
+                except (ValueError, UnicodeDecodeError):
                     return
                 headers = {}
                 while True:
@@ -85,13 +85,26 @@ class JsonRpcServer:
                     await self._respond(writer, 413, b"body too large")
                     return
                 body = await reader.readexactly(length) if length else b""
-                if method.upper() != "POST":
-                    await self._respond(writer, 405, b"POST only")
-                    continue
                 if self.api_key is not None and headers.get(
                     "x-api-key"
                 ) != self.api_key:
+                    # key gates EVERYTHING, including the metrics scrape
                     await self._respond(writer, 403, b"bad api key")
+                    continue
+                if method.upper() == "GET" and _path.startswith("/metrics"):
+                    # Prometheus scrape endpoint (reference MetricsService,
+                    # RPC/HTTP/MetricsService.cs:7-26)
+                    from ..utils import metrics as _metrics
+
+                    await self._respond(
+                        writer,
+                        200,
+                        _metrics.render_text().encode(),
+                        ctype="text/plain; version=0.0.4",
+                    )
+                    continue
+                if method.upper() != "POST":
+                    await self._respond(writer, 405, b"POST only")
                     continue
                 payload = await self._process(body)
                 await self._respond(

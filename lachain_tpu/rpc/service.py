@@ -144,6 +144,17 @@ class RpcService:
             return None
         rec = TransactionReceipt.decode(raw)
         block = self.node.block_manager.block_by_height(rec.block_index)
+        # contractAddress only for actual deployments (txs to the deploy
+        # system contract) — any call may legitimately RETURN 20 bytes
+        from ..core.system_contracts import DEPLOY_ADDRESS
+
+        stx = self.node.block_manager.transaction_by_hash(h)
+        deployed = (
+            stx is not None
+            and stx.tx.to == DEPLOY_ADDRESS
+            and rec.status == 1
+            and len(rec.return_data) == 20
+        )
         return {
             "transactionHash": _h(rec.tx_hash),
             "blockNumber": _hex(rec.block_index),
@@ -152,9 +163,7 @@ class RpcService:
             "from": _h(rec.sender),
             "gasUsed": _hex(rec.gas_used),
             "status": _hex(rec.status),
-            "contractAddress": _h(rec.return_data)
-            if len(rec.return_data) == 20
-            else None,
+            "contractAddress": _h(rec.return_data) if deployed else None,
             "returnData": _h(rec.return_data),
             "logs": self._logs_for_tx(rec.tx_hash),
         }
@@ -234,16 +243,16 @@ class RpcService:
     def eth_getLogs(self, flt=None):
         flt = flt or {}
         bm = self.node.block_manager
-        frm = (
-            _unhex(flt["fromBlock"])
-            if flt.get("fromBlock") not in (None, "latest")
-            else bm.current_height()
-        )
-        to = (
-            _unhex(flt["toBlock"])
-            if flt.get("toBlock") not in (None, "latest")
-            else bm.current_height()
-        )
+
+        def tag_to_height(tag, default):
+            if tag in (None, "latest", "pending"):
+                return default
+            if tag == "earliest":
+                return 0
+            return _unhex(tag)
+
+        frm = tag_to_height(flt.get("fromBlock"), bm.current_height())
+        to = tag_to_height(flt.get("toBlock"), bm.current_height())
         to = min(to, bm.current_height())
         if to - frm > 1000:
             raise JsonRpcError(-32005, "block range too wide (max 1000)")
@@ -251,6 +260,7 @@ class RpcService:
             _bytes(flt["address"]) if flt.get("address") else None
         )
         out = []
+        snap = self._snap()  # one snapshot for the whole scan
         for height in range(frm, to + 1):
             block = bm.block_by_height(height)
             if block is None:
@@ -258,14 +268,14 @@ class RpcService:
             for th in block.tx_hashes:
                 out.extend(
                     log
-                    for log in self._logs_for_tx(th, block)
+                    for log in self._logs_for_tx(th, block, snap)
                     if want_addr is None
                     or _bytes(log["address"]) == want_addr
                 )
         return out
 
-    def _logs_for_tx(self, tx_hash: bytes, block=None) -> List[dict]:
-        snap = self._snap()
+    def _logs_for_tx(self, tx_hash: bytes, block=None, snap=None) -> List[dict]:
+        snap = snap if snap is not None else self._snap()
         out = []
         i = 0
         while True:
@@ -332,6 +342,15 @@ class RpcService:
             "stake": _hex(stake),
             "isValidator": in_set,
             "publicKey": _h(pub) if pub else None,
+        }
+
+    def la_metrics(self):
+        """Timer/counter snapshot (the per-era crypto benchmark counters
+        plus chain gauges) without resetting."""
+        from ..utils import metrics
+
+        return {
+            "timers": metrics.timer_snapshot(reset=False),
         }
 
     def validator_status(self):

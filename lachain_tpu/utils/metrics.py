@@ -1,0 +1,115 @@
+"""Metrics + per-era crypto operation counters.
+
+Two reference subsystems collapsed into one module:
+
+  * TimeBenchmark — counters wrapped around every crypto hot op, dumped and
+    reset at FinishEra (/root/reference/src/Lachain.Crypto/DefaultCrypto.cs:
+    47-69, TPKE/PublicKey.cs:13-14, ThresholdSignature/ThresholdSigner.cs:
+    13-15; SURVEY.md §7 names this a parity requirement for honest baseline
+    comparison).
+  * Prometheus-style counters/gauges (AbstractProtocol.cs:15-22,
+    BlockManager.cs:62-127, RPC/HTTP/MetricsService.cs:7-26) — rendered in
+    text exposition format via `render_text()` and served by the RPC layer.
+
+Thread-safe; everything lives in one process-global registry so the node,
+crypto layer and RPC agree on a single view.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Tuple
+
+_lock = threading.Lock()
+# name -> (count, total_seconds)
+_timers: Dict[str, Tuple[int, float]] = {}
+# name -> value
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+
+
+@contextmanager
+def measure(name: str):
+    """Time one operation under `name` (TimeBenchmark.Measure role)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            cnt, total = _timers.get(name, (0, 0.0))
+            _timers[name] = (cnt + 1, total + dt)
+
+
+def timed(name: str):
+    """Decorator form of measure() for instrumenting crypto entry points."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with measure(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def inc(name: str, amount: float = 1.0) -> None:
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + amount
+
+
+def set_gauge(name: str, value: float) -> None:
+    with _lock:
+        _gauges[name] = value
+
+
+def observe(name: str, seconds: float) -> None:
+    with _lock:
+        cnt, total = _timers.get(name, (0, 0.0))
+        _timers[name] = (cnt + 1, total + seconds)
+
+
+def timer_snapshot(reset: bool = False) -> Dict[str, dict]:
+    """{name: {count, total_ms, avg_ms}} — the per-era dump
+    (DefaultCrypto.ResetBenchmark shape)."""
+    with _lock:
+        snap = {
+            name: {
+                "count": cnt,
+                "total_ms": round(total * 1e3, 3),
+                "avg_ms": round(total * 1e3 / cnt, 4) if cnt else 0.0,
+            }
+            for name, (cnt, total) in _timers.items()
+        }
+        if reset:
+            _timers.clear()
+    return snap
+
+
+def render_text() -> str:
+    """Prometheus text exposition of counters, gauges and timers."""
+    lines = []
+    with _lock:
+        for name, v in sorted(_counters.items()):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {v}")
+        for name, v in sorted(_gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {v}")
+        for name, (cnt, total) in sorted(_timers.items()):
+            lines.append(f"# TYPE {name}_seconds summary")
+            lines.append(f"{name}_seconds_count {cnt}")
+            lines.append(f"{name}_seconds_sum {total}")
+    return "\n".join(lines) + "\n"
+
+
+def reset_all_for_tests() -> None:
+    with _lock:
+        _timers.clear()
+        _counters.clear()
+        _gauges.clear()

@@ -1,0 +1,69 @@
+"""Observability: per-era crypto counters, metrics exposition, watchdog
+breadcrumbs (reference TimeBenchmark/DefaultCrypto.cs:47-69,
+AbstractProtocol.cs:113-135, MetricsService.cs:7-26)."""
+import time
+
+from lachain_tpu.utils import metrics
+
+
+def test_measure_and_snapshot_reset():
+    metrics.reset_all_for_tests()
+    with metrics.measure("crypto_test_op"):
+        time.sleep(0.01)
+    with metrics.measure("crypto_test_op"):
+        pass
+    snap = metrics.timer_snapshot(reset=True)
+    assert snap["crypto_test_op"]["count"] == 2
+    assert snap["crypto_test_op"]["total_ms"] >= 10
+    assert metrics.timer_snapshot() == {}
+
+
+def test_crypto_ops_are_instrumented():
+    metrics.reset_all_for_tests()
+    from lachain_tpu.crypto import ecdsa
+
+    priv = ecdsa.generate_private_key()
+    sig = ecdsa.sign_hash(priv, b"\x01" * 32)
+    assert ecdsa.verify_hash(ecdsa.public_key_bytes(priv), b"\x01" * 32, sig)
+    snap = metrics.timer_snapshot()
+    assert snap["crypto_ec_sign"]["count"] == 1
+    assert snap["crypto_ec_verify"]["count"] == 1
+
+
+def test_render_text_exposition():
+    metrics.reset_all_for_tests()
+    metrics.inc("consensus_messages_processed", 3)
+    metrics.set_gauge("chain_height", 7)
+    metrics.observe("block_execute", 0.5)
+    text = metrics.render_text()
+    assert "consensus_messages_processed 3.0" in text
+    assert "chain_height 7" in text
+    assert "block_execute_seconds_count 1" in text
+
+
+def test_protocol_breadcrumbs():
+    metrics.reset_all_for_tests()
+    import random
+
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.consensus.simulator import SimulatedNetwork
+    from lachain_tpu.consensus import messages as M
+
+    class Rng:
+        def __init__(self, seed=1):
+            self._r = random.Random(seed)
+
+        def randbelow(self, n):
+            return self._r.randrange(n)
+
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(3))
+    net = SimulatedNetwork(pub, privs, era=1, seed=4)
+    pid = M.BinaryAgreementId(era=1, agreement=0)
+    for i in range(4):
+        net.post_request(i, pid, i % 2 == 0)
+    assert net.run(lambda: all(r.result_of(pid) is not None for r in net.routers))
+    proto = net.routers[0].protocol(pid)
+    assert proto.last_message != "<created>"
+    assert proto.last_activity >= proto.started_at
+    snap_counters = metrics.render_text()
+    assert "consensus_messages_processed" in snap_counters
