@@ -1,0 +1,99 @@
+"""BASELINE.json config #4: N-validator simulated consensus throughput.
+
+Runs full HoneyBadgerBFT eras (RBC + BA + common coin + TPKE threshold
+decryption, real cryptography) over the deterministic in-process simulator
+(the reference's DeliveryService harness shape,
+test/Lachain.ConsensusTest/BroadcastSimulator.cs:16-225) and reports
+era latency / tx throughput as ONE JSON line.
+
+Usage: python benchmarks/bench_consensus_sim.py [--n 64] [--txs 1000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--txs", type=int, default=1000)
+    ap.add_argument("--eras", type=int, default=2)
+    ap.add_argument("--max-messages", type=int, default=20_000_000)
+    args = ap.parse_args()
+
+    from lachain_tpu.core.devnet import Devnet
+    from lachain_tpu.core.types import Transaction, sign_transaction
+    from lachain_tpu.crypto import ecdsa
+
+    n = args.n
+    f = (n - 1) // 3
+    users = [ecdsa.generate_private_key(Rng(5 + i)) for i in range(16)]
+    balances = {
+        ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)): 10**24
+        for u in users
+    }
+    net = Devnet(
+        n,
+        f,
+        initial_balances=balances,
+        seed=7,
+        txs_per_block=args.txs,
+    )
+
+    total_txs = 0
+    times = []
+    nonces = [0] * len(users)
+    for era in range(1, args.eras + 1):
+        for k in range(args.txs):
+            u = k % len(users)
+            stx = sign_transaction(
+                Transaction(
+                    to=bytes([era]) * 20,
+                    value=1,
+                    nonce=nonces[u],
+                    gas_price=1 + (k % 7),
+                    gas_limit=21000,
+                ),
+                users[u],
+                net.chain_id,
+            )
+            net.submit_tx(stx)
+            nonces[u] += 1
+        t0 = time.perf_counter()
+        blocks = net.run_era(era, max_messages=args.max_messages)
+        times.append(time.perf_counter() - t0)
+        total_txs += len(blocks[0].tx_hashes)
+
+    era_s = min(times)
+    print(
+        json.dumps(
+            {
+                "metric": "consensus_sim_era_latency_s",
+                "value": round(era_s, 3),
+                "unit": f"s/era @ N={n} simulated, {args.txs} tx submitted",
+                "n_validators": n,
+                "f": f,
+                "txs_per_era": total_txs // args.eras,
+                "tx_per_s": round(total_txs / sum(times), 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""BASELINE.json config #3: 4-validator TCP devnet, 1k-tx blocks.
+
+Real nodes over localhost TCP (signed batches, priority workers — the
+docker-compose.4nodes flow in-process), 1000-transaction blocks; reports
+blocks/s and mined-tx throughput as ONE JSON line.
+
+Usage: python benchmarks/bench_devnet_tcp.py [--txs 1000] [--eras 3]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+async def run(args) -> dict:
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.core.node import Node
+    from lachain_tpu.core.types import Transaction, sign_transaction
+    from lachain_tpu.crypto import ecdsa
+
+    n, f = 4, 1
+    chain = 225
+    pub, privs = trusted_key_gen(n, f, rng=Rng(2))
+    users = [ecdsa.generate_private_key(Rng(9 + i)) for i in range(16)]
+    balances = {
+        ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)): 10**24
+        for u in users
+    }
+    nodes = [
+        Node(
+            index=i,
+            public_keys=pub,
+            private_keys=privs[i],
+            chain_id=chain,
+            initial_balances=balances,
+            flush_interval=0.01,
+            txs_per_block=args.txs,
+        )
+        for i in range(n)
+    ]
+    for node in nodes:
+        await node.start()
+    addrs = [node.address for node in nodes]
+    for node in nodes:
+        node.connect(addrs)
+
+    total_mined = 0
+    times = []
+    nonces = [0] * len(users)
+    for era in range(1, args.eras + 1):
+        for k in range(args.txs):
+            u = k % len(users)
+            stx = sign_transaction(
+                Transaction(
+                    to=bytes([era]) * 20,
+                    value=1,
+                    nonce=nonces[u],
+                    gas_price=1 + (k % 7),
+                    gas_limit=21000,
+                ),
+                users[u],
+                chain,
+            )
+            nonces[u] += 1
+            for node in nodes:
+                node.pool.add(stx)  # pre-distributed (gossip not timed)
+        await asyncio.sleep(0.3)
+        t0 = time.perf_counter()
+        blocks = await asyncio.gather(*(v.run_era(era) for v in nodes))
+        times.append(time.perf_counter() - t0)
+        total_mined += len(blocks[0].tx_hashes)
+    for node in nodes:
+        await node.stop()
+    era_s = min(times)
+    return {
+        "metric": "devnet_tcp_block_latency_s",
+        "value": round(era_s, 3),
+        "unit": f"s/block @ 4 validators TCP, {args.txs}-tx blocks",
+        "blocks_per_s": round(1.0 / era_s, 3),
+        "mined_tx_per_s": round(total_mined / sum(times), 1),
+        "txs_per_block": total_mined // args.eras,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--txs", type=int, default=1000)
+    ap.add_argument("--eras", type=int, default=3)
+    args = ap.parse_args()
+    print(json.dumps(asyncio.run(run(args))))
+
+
+if __name__ == "__main__":
+    main()

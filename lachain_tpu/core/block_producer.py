@@ -46,15 +46,29 @@ class BlockProducer:
         pool: TransactionPool,
         n_validators: int,
         txs_per_block: int = DEFAULT_TXS_PER_BLOCK,
+        proposal_seed: int = -1,
     ):
         self.bm = block_manager
         self.pool = pool
         self.n = n_validators
         self.txs_per_block = txs_per_block
+        # per-validator randomized proposals (RandomSamplingQueue role):
+        # HB blocks carry the union of n proposals, so identical top-fee
+        # picks would cap blocks at txs_per_block / n distinct txs
+        self.proposal_seed = proposal_seed
 
     # -- proposal ---------------------------------------------------------------
     def get_transactions_to_propose(self) -> List[SignedTransaction]:
-        return self.pool.peek(max(self.txs_per_block // max(self.n, 1), 1))
+        import random as _random
+
+        rng = (
+            _random.Random((self.proposal_seed << 20) ^ self.bm.current_height())
+            if self.proposal_seed >= 0
+            else None
+        )
+        return self.pool.peek(
+            max(self.txs_per_block // max(self.n, 1), 1), rng=rng
+        )
 
     # -- header -----------------------------------------------------------------
     def create_header(

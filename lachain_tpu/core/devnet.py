@@ -79,7 +79,7 @@ class Devnet:
                 chain_id,
                 account_nonce=self._nonce_reader(state),
             )
-            producer = BlockProducer(bm, pool, n, txs_per_block)
+            producer = BlockProducer(bm, pool, n, txs_per_block, proposal_seed=i)
             self.nodes.append(
                 DevnetNode(
                     index=i,

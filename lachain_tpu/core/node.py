@@ -78,7 +78,11 @@ class Node:
             self.kv, chain_id, account_nonce=self._account_nonce
         )
         self.producer = BlockProducer(
-            self.block_manager, self.pool, public_keys.n, txs_per_block
+            self.block_manager,
+            self.pool,
+            public_keys.n,
+            txs_per_block,
+            proposal_seed=max(index, 0),
         )
         self.network = NetworkManager(
             private_keys.ecdsa_priv, host, port, flush_interval=flush_interval
@@ -545,7 +549,7 @@ class Node:
         DefaultCrypto.cs:47-69)."""
         from ..utils import metrics
 
-        snap = metrics.timer_snapshot(reset=True)
+        snap = metrics.timer_snapshot(reset=True, reset_prefix="crypto_")
         crypto = {k: v for k, v in snap.items() if k.startswith("crypto_")}
         if crypto:
             logger.info("era %d crypto benchmark: %s", era, crypto)

@@ -11,6 +11,7 @@ Parity with the reference's TransactionPool
 from __future__ import annotations
 
 import heapq
+import random
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -77,8 +78,45 @@ class TransactionPool:
                 nonce += 1
             return nonce
 
-    def peek(self, max_txs: int) -> List[SignedTransaction]:
-        """Fee-ordered proposal with per-sender nonce continuity."""
+    def peek(
+        self, max_txs: int, rng: Optional["random.Random"] = None
+    ) -> List[SignedTransaction]:
+        """Fee-ordered proposal with per-sender nonce continuity.
+
+        With `rng`, the proposal is a RANDOM sample from a fee-ordered
+        window of up to 4*max_txs executable txs (the reference's
+        RandomSamplingQueue role, Containers/RandomSamplingQueue.cs):
+        HoneyBadger blocks carry the UNION of n proposals, so diversity
+        across validators — not identical top-fee picks — is what fills
+        blocks. Sampling keeps per-sender nonce chains contiguous by
+        sampling SENDERS, then taking their chain prefixes."""
+        if rng is not None:
+            window = self._peek_ordered_with_senders(4 * max_txs)
+            if len(window) > max_txs:
+                by_sender: Dict[bytes, List[SignedTransaction]] = {}
+                order: List[bytes] = []
+                for s, stx in window:
+                    if s not in by_sender:
+                        by_sender[s] = []
+                        order.append(s)
+                    by_sender[s].append(stx)
+                rng.shuffle(order)
+                picked: List[SignedTransaction] = []
+                for s in order:
+                    take = min(len(by_sender[s]), max_txs - len(picked))
+                    picked.extend(by_sender[s][:take])
+                    if len(picked) >= max_txs:
+                        break
+                return picked
+            return [stx for _, stx in window]
+        return self._peek_ordered(max_txs)
+
+    def _peek_ordered(self, max_txs: int) -> List[SignedTransaction]:
+        return [stx for _, stx in self._peek_ordered_with_senders(max_txs)]
+
+    def _peek_ordered_with_senders(
+        self, max_txs: int
+    ) -> List[Tuple[bytes, SignedTransaction]]:
         with self._lock:
             per_sender: Dict[bytes, List[SignedTransaction]] = {}
             for h, stx in self._txs.items():
@@ -104,12 +142,12 @@ class TransactionPool:
                 h = stx.hash()
                 return (-stx.tx.gas_price, bytes(255 - b for b in h))
 
-            picked: List[SignedTransaction] = []
+            picked: List[Tuple[bytes, SignedTransaction]] = []
             heap = [(heap_key(chain[0]), s, 0) for s, chain in chains.items()]
             heapq.heapify(heap)
             while len(picked) < max_txs and heap:
                 _, s, i = heapq.heappop(heap)
-                picked.append(chains[s][i])
+                picked.append((s, chains[s][i]))
                 if i + 1 < len(chains[s]):
                     heapq.heappush(heap, (heap_key(chains[s][i + 1]), s, i + 1))
             return picked

@@ -86,11 +86,17 @@ class SignedTransaction:
         return keccak256(self.encode())
 
     def sender(self, chain_id: int) -> Optional[bytes]:
-        """Recovered 20-byte sender address, or None if invalid."""
+        """Recovered 20-byte sender address, or None if invalid. Cached:
+        ordering, execution and the pool all ask repeatedly, and ECDSA
+        recovery dominates otherwise (reference caches the recovery in
+        TransactionManager's verify cache, TransactionManager.cs:141-171)."""
+        cached = self.__dict__.get("_sender_cache")
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
         pub = ecdsa.recover_hash(self.tx.signing_hash(chain_id), self.signature)
-        if pub is None:
-            return None
-        return ecdsa.address_from_public_key(pub)
+        addr = None if pub is None else ecdsa.address_from_public_key(pub)
+        object.__setattr__(self, "_sender_cache", (chain_id, addr))
+        return addr
 
 
 def sign_transaction(

@@ -123,9 +123,41 @@ def _rfc6979_k(priv: bytes, msg_hash: bytes) -> int:
         holder = hmac.new(key, holder, hashlib.sha256).digest()
 
 
+_native_lib_cache = [False, None]  # [attempted, lib]
+
+
+def _native_lib():
+    """The C++ secp256k1 backend (lachain_tpu/crypto/native/secp256k1.cpp,
+    cross-checked against this module's pure-Python oracle in
+    tests/test_ecdsa.py). LACHAIN_TPU_ECDSA=python forces the oracle."""
+    if not _native_lib_cache[0]:
+        _native_lib_cache[0] = True
+        import os as _os
+
+        if _os.environ.get("LACHAIN_TPU_ECDSA") != "python":
+            try:
+                from .native_backend import load_lib
+
+                _native_lib_cache[1] = load_lib()
+            except Exception:
+                _native_lib_cache[1] = None
+    return _native_lib_cache[1]
+
+
 @metrics.timed("crypto_ec_sign")
 def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
     """65-byte recoverable signature r(32) || s(32) || v(1), low-s enforced."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes as _ct
+
+        out = (_ct.c_ubyte * 65)()
+        if lib.lt_ec_sign(priv, msg_hash, out) == 0:
+            return bytes(out)
+    return _sign_hash_py(priv, msg_hash)
+
+
+def _sign_hash_py(priv: bytes, msg_hash: bytes) -> bytes:
     assert len(msg_hash) == 32
     z = int.from_bytes(msg_hash, "big") % N
     d = int.from_bytes(priv, "big")
@@ -152,6 +184,13 @@ def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
 
 @metrics.timed("crypto_ec_verify")
 def verify_hash(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
+    lib = _native_lib()
+    if lib is not None and len(pub) == 33:
+        return bool(lib.lt_ec_verify(pub, msg_hash, sig, len(sig)))
+    return _verify_hash_py(pub, msg_hash, sig)
+
+
+def _verify_hash_py(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
     if len(sig) != 65:
         return False
     try:
@@ -222,6 +261,18 @@ def ecies_decrypt(priv: bytes, data: bytes) -> bytes:
 @metrics.timed("crypto_ec_recover")
 def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     """Recover the compressed public key from a 65-byte signature."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes as _ct
+
+        out = (_ct.c_ubyte * 33)()
+        if lib.lt_ec_recover(msg_hash, sig, len(sig), out) == 0:
+            return bytes(out)
+        return None
+    return _recover_hash_py(msg_hash, sig)
+
+
+def _recover_hash_py(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     if len(sig) != 65:
         return None
     r = int.from_bytes(sig[:32], "big")

@@ -57,8 +57,40 @@ def _keccak_f(a: List[List[int]]) -> None:
         a[0][0] ^= _RC[rnd]
 
 
+_native_cache = [False, None]
+
+
+def _native_lib():
+    """The C++ backend's lt_keccak256 (cross-checked against the pure-Python
+    implementation below in tests/test_hashes.py). Keccak dominates tx/block
+    hashing, so the dispatch matters for pool ingest and block execution."""
+    if not _native_cache[0]:
+        _native_cache[0] = True
+        import os as _os
+
+        if _os.environ.get("LACHAIN_TPU_HASHES") != "python":
+            try:
+                from .native_backend import load_lib
+
+                _native_cache[1] = load_lib()
+            except Exception:
+                _native_cache[1] = None
+    return _native_cache[1]
+
+
 def keccak256(data: bytes) -> bytes:
     """Keccak-256 with legacy 0x01 padding (Ethereum-style), not SHA3-256."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes as _ct
+
+        out = (_ct.c_ubyte * 32)()
+        lib.lt_keccak256(data, len(data), out)
+        return bytes(out)
+    return _keccak256_py(data)
+
+
+def _keccak256_py(data: bytes) -> bytes:
     rate = 136
     state = [[0] * 5 for _ in range(5)]
     padded = bytearray(data)

@@ -1,0 +1,749 @@
+// secp256k1 ECDSA: sign / verify / recover — native backend.
+//
+// The role of Secp256k1.Native in the reference
+// (/root/reference/src/Lachain.Crypto/Lachain.Crypto.csproj:21-22,
+// DefaultCrypto.cs:79-195). The pure-Python implementation in
+// lachain_tpu/crypto/ecdsa.py is the semantic oracle — this file reproduces
+// its exact wire behavior (RFC 6979 nonce chain incl. the retry tweak,
+// low-s normalization with parity-bit flip, the v|=2 flag for r >= n,
+// recovery semantics) at native speed; conformance is enforced by
+// tests/test_ecdsa.py cross-checks.
+//
+// Compiled into libbls381.so alongside the BLS backend (one shared object,
+// one ctypes load path).
+
+#include <cstdint>
+#include <cstring>
+
+namespace secp {
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+typedef uint32_t u32;
+typedef uint8_t u8;
+
+// ---------------------------------------------------------------------------
+// generic 4x64 modular arithmetic (Montgomery) parameterized by modulus
+// ---------------------------------------------------------------------------
+
+struct Mod {
+  u64 m[4];    // modulus, little-endian limbs
+  u64 inv;     // -m^-1 mod 2^64
+  u64 r2[4];   // (2^256)^2 mod m
+};
+
+static inline int cmp4(const u64 *a, const u64 *b) {
+  for (int i = 3; i >= 0; i--) {
+    if (a[i] < b[i]) return -1;
+    if (a[i] > b[i]) return 1;
+  }
+  return 0;
+}
+
+static inline bool is_zero4(const u64 *a) {
+  return (a[0] | a[1] | a[2] | a[3]) == 0;
+}
+
+static inline u64 sub4(u64 *z, const u64 *a, const u64 *b) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 cur = (u128)a[i] - b[i] - (u64)borrow;
+    z[i] = (u64)cur;
+    borrow = (cur >> 64) ? 1 : 0;
+  }
+  return (u64)borrow;
+}
+
+static inline u64 add4(u64 *z, const u64 *a, const u64 *b) {
+  u128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 cur = (u128)a[i] + b[i] + (u64)carry;
+    z[i] = (u64)cur;
+    carry = cur >> 64;
+  }
+  return (u64)carry;
+}
+
+static void mod_add(const Mod &M, u64 *z, const u64 *a, const u64 *b) {
+  u64 carry = add4(z, a, b);
+  if (carry || cmp4(z, M.m) >= 0) {
+    u64 t[4];
+    sub4(t, z, M.m);
+    memcpy(z, t, 32);
+  }
+}
+
+static void mod_sub(const Mod &M, u64 *z, const u64 *a, const u64 *b) {
+  u64 t[4];
+  if (sub4(t, a, b)) add4(t, t, M.m);
+  memcpy(z, t, 32);
+}
+
+// Montgomery product: z = a * b * 2^-256 mod m (CIOS)
+static void mont_mul(const Mod &M, u64 *z, const u64 *a, const u64 *b) {
+  u64 t[6];
+  memset(t, 0, sizeof(t));
+  for (int i = 0; i < 4; i++) {
+    u64 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      u128 cur = (u128)a[i] * b[j] + t[j] + carry;
+      t[j] = (u64)cur;
+      carry = (u64)(cur >> 64);
+    }
+    u128 cur = (u128)t[4] + carry;
+    t[4] = (u64)cur;
+    t[5] = (u64)(cur >> 64);
+
+    u64 mfac = t[0] * M.inv;
+    u128 c2 = (u128)mfac * M.m[0] + t[0];
+    carry = (u64)(c2 >> 64);
+    for (int j = 1; j < 4; j++) {
+      u128 c3 = (u128)mfac * M.m[j] + t[j] + carry;
+      t[j - 1] = (u64)c3;
+      carry = (u64)(c3 >> 64);
+    }
+    u128 c4 = (u128)t[4] + carry;
+    t[3] = (u64)c4;
+    t[4] = t[5] + (u64)(c4 >> 64);
+    t[5] = 0;
+  }
+  if (t[4] || cmp4(t, M.m) >= 0) {
+    u64 s[4];
+    sub4(s, t, M.m);
+    memcpy(z, s, 32);
+  } else {
+    memcpy(z, t, 32);
+  }
+}
+
+static void to_mont(const Mod &M, u64 *z, const u64 *a) {
+  mont_mul(M, z, a, M.r2);
+}
+
+static void from_mont(const Mod &M, u64 *z, const u64 *a) {
+  u64 one[4] = {1, 0, 0, 0};
+  mont_mul(M, z, a, one);
+}
+
+// z = a^-1 mod m via Fermat (m prime): a^(m-2); exponent passed plain
+static void mod_pow(const Mod &M, u64 *z, const u64 *base_mont,
+                    const u64 *exp) {
+  u64 acc[4];
+  u64 one[4] = {1, 0, 0, 0};
+  to_mont(M, acc, one);
+  for (int i = 255; i >= 0; i--) {
+    mont_mul(M, acc, acc, acc);
+    if ((exp[i / 64] >> (i % 64)) & 1) mont_mul(M, acc, acc, base_mont);
+  }
+  memcpy(z, acc, 32);  // stays in Montgomery form
+}
+
+static void mod_inv(const Mod &M, u64 *z, const u64 *a_mont) {
+  u64 exp[4];
+  u64 two[4] = {2, 0, 0, 0};
+  sub4(exp, M.m, two);
+  mod_pow(M, z, a_mont, exp);
+}
+
+// ---------------------------------------------------------------------------
+// curve constants
+// ---------------------------------------------------------------------------
+
+static const Mod FP = {
+    {0xFFFFFFFEFFFFFC2Full, 0xFFFFFFFFFFFFFFFFull, 0xFFFFFFFFFFFFFFFFull,
+     0xFFFFFFFFFFFFFFFFull},
+    0xD838091DD2253531ull,
+    // 2^512 mod p
+    {0x000007A2000E90A1ull, 0x0000000000000001ull, 0, 0},
+};
+
+static const Mod FN = {
+    {0xBFD25E8CD0364141ull, 0xBAAEDCE6AF48A03Bull, 0xFFFFFFFFFFFFFFFEull,
+     0xFFFFFFFFFFFFFFFFull},
+    0x4B0DFF665588B13Full,
+    // 2^512 mod n
+    {0x896CF21467D7D140ull, 0x741496C20E7CF878ull, 0xE697F5E45BCD07C6ull,
+     0x9D671CD581C69BC5ull},
+};
+
+// generator (plain form)
+static const u64 GX[4] = {0x59F2815B16F81798ull, 0x029BFCDB2DCE28D9ull,
+                          0x55A06295CE870B07ull, 0x79BE667EF9DCBBACull};
+static const u64 GY[4] = {0x9C47D08FFB10D4B8ull, 0xFD17B448A6855419ull,
+                          0x5DA4FBFC0E1108A8ull, 0x483ADA7726A3C465ull};
+
+static void load_be(u64 *z, const u8 *in) {
+  for (int i = 0; i < 4; i++) {
+    u64 v = 0;
+    for (int j = 0; j < 8; j++) v = (v << 8) | in[(3 - i) * 8 + j];
+    z[i] = v;
+  }
+}
+
+static void store_be(u8 *out, const u64 *a) {
+  for (int i = 0; i < 4; i++) {
+    u64 v = a[3 - i];
+    for (int j = 0; j < 8; j++) out[i * 8 + j] = (u8)(v >> (56 - 8 * j));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// group (Jacobian, a = 0 curve y^2 = x^3 + 7) — coordinates in Montgomery
+// ---------------------------------------------------------------------------
+
+struct Pt {
+  u64 x[4], y[4], z[4];
+  bool inf;
+};
+
+static void pt_dbl(Pt &r, const Pt &p) {
+  if (p.inf || is_zero4(p.y)) {
+    r.inf = true;
+    return;
+  }
+  u64 A[4], B[4], C[4], D[4], E[4], F[4], t[4];
+  mont_mul(FP, A, p.x, p.x);         // X^2
+  mont_mul(FP, B, p.y, p.y);         // Y^2
+  mont_mul(FP, C, B, B);             // Y^4
+  mod_add(FP, t, p.x, B);
+  mont_mul(FP, D, t, t);
+  mod_sub(FP, D, D, A);
+  mod_sub(FP, D, D, C);
+  mod_add(FP, D, D, D);              // 2((X+B)^2 - A - C)
+  mod_add(FP, E, A, A);
+  mod_add(FP, E, E, A);              // 3A
+  mont_mul(FP, F, E, E);
+  mod_sub(FP, r.x, F, D);
+  mod_sub(FP, r.x, r.x, D);          // F - 2D
+  mod_add(FP, t, C, C);
+  mod_add(FP, t, t, t);
+  mod_add(FP, t, t, t);              // 8C
+  u64 y3[4];
+  mod_sub(FP, y3, D, r.x);
+  mont_mul(FP, y3, E, y3);
+  mod_sub(FP, r.y, y3, t);
+  mont_mul(FP, t, p.y, p.z);
+  mod_add(FP, r.z, t, t);
+  r.inf = false;
+}
+
+static void pt_add(Pt &r, const Pt &p, const Pt &q) {
+  if (p.inf) {
+    r = q;
+    return;
+  }
+  if (q.inf) {
+    r = p;
+    return;
+  }
+  u64 z1z1[4], z2z2[4], u1[4], u2[4], s1[4], s2[4], h[4], rr[4], t[4];
+  mont_mul(FP, z1z1, p.z, p.z);
+  mont_mul(FP, z2z2, q.z, q.z);
+  mont_mul(FP, u1, p.x, z2z2);
+  mont_mul(FP, u2, q.x, z1z1);
+  mont_mul(FP, t, p.y, q.z);
+  mont_mul(FP, s1, t, z2z2);
+  mont_mul(FP, t, q.y, p.z);
+  mont_mul(FP, s2, t, z1z1);
+  mod_sub(FP, h, u2, u1);
+  mod_sub(FP, rr, s2, s1);
+  if (is_zero4(h)) {
+    if (is_zero4(rr)) {
+      pt_dbl(r, p);
+    } else {
+      r.inf = true;
+    }
+    return;
+  }
+  u64 i[4], j[4], v[4], r2[4];
+  mod_add(FP, t, h, h);
+  mont_mul(FP, i, t, t);             // (2H)^2
+  mont_mul(FP, j, h, i);
+  mod_add(FP, r2, rr, rr);
+  mont_mul(FP, v, u1, i);
+  mont_mul(FP, t, r2, r2);
+  mod_sub(FP, t, t, j);
+  mod_sub(FP, t, t, v);
+  mod_sub(FP, r.x, t, v);            // r2^2 - J - 2V
+  mod_sub(FP, t, v, r.x);
+  mont_mul(FP, t, r2, t);
+  u64 s1j[4];
+  mont_mul(FP, s1j, s1, j);
+  mod_sub(FP, t, t, s1j);
+  mod_sub(FP, r.y, t, s1j);
+  u64 zz[4];
+  mont_mul(FP, zz, p.z, q.z);
+  mont_mul(FP, zz, zz, h);
+  mod_add(FP, r.z, zz, zz);
+  r.inf = false;
+}
+
+static void pt_mul(Pt &r, const Pt &p, const u64 *k /* plain scalar */) {
+  Pt acc;
+  acc.inf = true;
+  for (int i = 255; i >= 0; i--) {
+    Pt d;
+    pt_dbl(d, acc);
+    acc = d;
+    if ((k[i / 64] >> (i % 64)) & 1) {
+      Pt s;
+      pt_add(s, acc, p);
+      acc = s;
+    }
+  }
+  r = acc;
+}
+
+// affine x, y (plain form); returns false for infinity
+static bool pt_affine(u64 *ax, u64 *ay, const Pt &p) {
+  if (p.inf) return false;
+  u64 zi[4], zi2[4], zi3[4], xm[4], ym[4];
+  mod_inv(FP, zi, p.z);
+  mont_mul(FP, zi2, zi, zi);
+  mont_mul(FP, zi3, zi2, zi);
+  mont_mul(FP, xm, p.x, zi2);
+  mont_mul(FP, ym, p.y, zi3);
+  from_mont(FP, ax, xm);
+  from_mont(FP, ay, ym);
+  return true;
+}
+
+static void gen_pt(Pt &g) {
+  to_mont(FP, g.x, GX);
+  to_mont(FP, g.y, GY);
+  u64 one[4] = {1, 0, 0, 0};
+  to_mont(FP, g.z, one);
+  g.inf = false;
+}
+
+// decompress a 33-byte pubkey; false if invalid
+static bool pt_decompress(Pt &p, const u8 *pub) {
+  if (pub[0] != 2 && pub[0] != 3) return false;
+  u64 x[4];
+  load_be(x, pub + 1);
+  if (cmp4(x, FP.m) >= 0) return false;
+  u64 xm[4], y2[4], seven[4] = {7, 0, 0, 0}, sm[4];
+  to_mont(FP, xm, x);
+  mont_mul(FP, y2, xm, xm);
+  mont_mul(FP, y2, y2, xm);
+  to_mont(FP, sm, seven);
+  mod_add(FP, y2, y2, sm);
+  // sqrt: y = y2^((p+1)/4)
+  u64 exp[4];
+  u64 one4[4] = {1, 0, 0, 0};
+  add4(exp, FP.m, one4);
+  // (p+1)/4: shift right by 2
+  for (int i = 0; i < 4; i++) {
+    exp[i] >>= 2;
+    if (i < 3) exp[i] |= exp[i + 1] << 62;
+  }
+  // note: p+1 overflows 4 limbs? p+1 < 2^256, p odd -> no overflow carry
+  u64 ym[4];
+  mod_pow(FP, ym, y2, exp);
+  u64 chk[4];
+  mont_mul(FP, chk, ym, ym);
+  if (cmp4(chk, y2) != 0) return false;
+  u64 y[4];
+  from_mont(FP, y, ym);
+  if ((y[0] & 1) != (u64)(pub[0] & 1)) {
+    u64 t[4];
+    sub4(t, FP.m, y);
+    to_mont(FP, ym, t);
+  }
+  p.x[0] = 0;  // fill below
+  memcpy(p.x, xm, 32);
+  memcpy(p.y, ym, 32);
+  u64 one[4] = {1, 0, 0, 0};
+  to_mont(FP, p.z, one);
+  p.inf = false;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 + HMAC (for the RFC 6979 nonce chain)
+// ---------------------------------------------------------------------------
+
+static const u32 K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+struct Sha256 {
+  u32 h[8];
+  u8 buf[64];
+  u64 total;
+  size_t fill;
+};
+
+static inline u32 rotr(u32 v, int s) { return (v >> s) | (v << (32 - s)); }
+
+static void sha_init(Sha256 &s) {
+  static const u32 H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  memcpy(s.h, H0, sizeof(H0));
+  s.total = 0;
+  s.fill = 0;
+}
+
+static void sha_block(Sha256 &s, const u8 *p) {
+  u32 w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((u32)p[4 * i] << 24) | ((u32)p[4 * i + 1] << 16) |
+           ((u32)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    u32 s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    u32 s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  u32 a = s.h[0], b = s.h[1], c = s.h[2], d = s.h[3], e = s.h[4], f = s.h[5],
+      g = s.h[6], hh = s.h[7];
+  for (int i = 0; i < 64; i++) {
+    u32 S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    u32 ch = (e & f) ^ (~e & g);
+    u32 t1 = hh + S1 + ch + K256[i] + w[i];
+    u32 S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    u32 maj = (a & b) ^ (a & c) ^ (b & c);
+    u32 t2 = S0 + maj;
+    hh = g;
+    g = f;
+    f = e;
+    e = d + t1;
+    d = c;
+    c = b;
+    b = a;
+    a = t1 + t2;
+  }
+  s.h[0] += a;
+  s.h[1] += b;
+  s.h[2] += c;
+  s.h[3] += d;
+  s.h[4] += e;
+  s.h[5] += f;
+  s.h[6] += g;
+  s.h[7] += hh;
+}
+
+static void sha_update(Sha256 &s, const u8 *data, size_t len) {
+  s.total += len;
+  while (len) {
+    size_t take = 64 - s.fill;
+    if (take > len) take = len;
+    memcpy(s.buf + s.fill, data, take);
+    s.fill += take;
+    data += take;
+    len -= take;
+    if (s.fill == 64) {
+      sha_block(s, s.buf);
+      s.fill = 0;
+    }
+  }
+}
+
+static void sha_final(Sha256 &s, u8 out[32]) {
+  u64 bits = s.total * 8;
+  u8 pad = 0x80;
+  sha_update(s, &pad, 1);
+  u8 zero = 0;
+  while (s.fill != 56) sha_update(s, &zero, 1);
+  u8 lenb[8];
+  for (int i = 0; i < 8; i++) lenb[i] = (u8)(bits >> (56 - 8 * i));
+  sha_update(s, lenb, 8);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (u8)(s.h[i] >> 24);
+    out[4 * i + 1] = (u8)(s.h[i] >> 16);
+    out[4 * i + 2] = (u8)(s.h[i] >> 8);
+    out[4 * i + 3] = (u8)s.h[i];
+  }
+}
+
+static void sha256(const u8 *data, size_t len, u8 out[32]) {
+  Sha256 s;
+  sha_init(s);
+  sha_update(s, data, len);
+  sha_final(s, out);
+}
+
+static void hmac_sha256(const u8 *key, size_t keylen, const u8 *m1,
+                        size_t l1, const u8 *m2, size_t l2, const u8 *m3,
+                        size_t l3, u8 out[32]) {
+  u8 k[64];
+  memset(k, 0, 64);
+  if (keylen > 64) {
+    sha256(key, keylen, k);
+  } else {
+    memcpy(k, key, keylen);
+  }
+  u8 ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  Sha256 s;
+  sha_init(s);
+  sha_update(s, ipad, 64);
+  if (l1) sha_update(s, m1, l1);
+  if (l2) sha_update(s, m2, l2);
+  if (l3) sha_update(s, m3, l3);
+  u8 inner[32];
+  sha_final(s, inner);
+  sha_init(s);
+  sha_update(s, opad, 64);
+  sha_update(s, inner, 32);
+  sha_final(s, out);
+}
+
+// RFC 6979 nonce (mirrors ecdsa.py:_rfc6979_k exactly)
+static void rfc6979_k(u64 *k_out, const u8 priv[32], const u8 hash[32]) {
+  u8 holder[32], key[32];
+  memset(holder, 0x01, 32);
+  memset(key, 0x00, 32);
+  u8 sep0 = 0x00, sep1 = 0x01;
+  u8 msg[65];
+  msg[0] = 0;  // placeholder
+  // key = HMAC(key, holder || 0x00 || priv || hash)
+  {
+    u8 cat[32 + 1 + 32 + 32];
+    memcpy(cat, holder, 32);
+    cat[32] = sep0;
+    memcpy(cat + 33, priv, 32);
+    memcpy(cat + 65, hash, 32);
+    hmac_sha256(key, 32, cat, sizeof(cat), nullptr, 0, nullptr, 0, key);
+  }
+  hmac_sha256(key, 32, holder, 32, nullptr, 0, nullptr, 0, holder);
+  {
+    u8 cat[32 + 1 + 32 + 32];
+    memcpy(cat, holder, 32);
+    cat[32] = sep1;
+    memcpy(cat + 33, priv, 32);
+    memcpy(cat + 65, hash, 32);
+    hmac_sha256(key, 32, cat, sizeof(cat), nullptr, 0, nullptr, 0, key);
+  }
+  hmac_sha256(key, 32, holder, 32, nullptr, 0, nullptr, 0, holder);
+  (void)msg;
+  while (true) {
+    hmac_sha256(key, 32, holder, 32, nullptr, 0, nullptr, 0, holder);
+    u64 k[4];
+    load_be(k, holder);
+    if (!is_zero4(k) && cmp4(k, FN.m) < 0) {
+      memcpy(k_out, k, 32);
+      return;
+    }
+    u8 cat[33];
+    memcpy(cat, holder, 32);
+    cat[32] = 0x00;
+    hmac_sha256(key, 32, cat, 33, nullptr, 0, nullptr, 0, key);
+    hmac_sha256(key, 32, holder, 32, nullptr, 0, nullptr, 0, holder);
+  }
+}
+
+}  // namespace secp
+
+// ---------------------------------------------------------------------------
+// exported API
+// ---------------------------------------------------------------------------
+
+using namespace secp;
+
+extern "C" {
+
+// returns 0 ok
+int lt_ec_pubkey(const u8 priv[32], u8 out[33]) {
+  u64 d[4];
+  load_be(d, priv);
+  if (is_zero4(d) || cmp4(d, FN.m) >= 0) return 1;
+  Pt g, q;
+  gen_pt(g);
+  pt_mul(q, g, d);
+  u64 ax[4], ay[4];
+  if (!pt_affine(ax, ay, q)) return 1;
+  out[0] = 0x02 | (u8)(ay[0] & 1);
+  store_be(out + 1, ax);
+  return 0;
+}
+
+// returns 0 ok; sig = r(32) || s(32) || v(1), low-s, recoverable
+int lt_ec_sign(const u8 priv[32], const u8 hash[32], u8 sig[65]) {
+  u64 d[4], z[4];
+  load_be(d, priv);
+  if (is_zero4(d) || cmp4(d, FN.m) >= 0) return 1;
+  load_be(z, hash);
+  if (cmp4(z, FN.m) >= 0) {
+    u64 t[4];
+    sub4(t, z, FN.m);
+    memcpy(z, t, 32);
+  }
+  u8 cur_hash[32];
+  memcpy(cur_hash, hash, 32);
+  int extra = 0;
+  while (true) {
+    u64 k[4];
+    rfc6979_k(k, priv, cur_hash);
+    Pt g, R;
+    gen_pt(g);
+    pt_mul(R, g, k);
+    u64 rx[4], ry[4];
+    if (!pt_affine(rx, ry, R)) return 1;
+    u64 r[4];
+    memcpy(r, rx, 32);
+    bool high_x = cmp4(r, FN.m) >= 0;
+    if (high_x) {
+      u64 t[4];
+      sub4(t, r, FN.m);
+      memcpy(r, t, 32);
+    }
+    if (is_zero4(r)) goto retry;
+    {
+      // s = k^-1 (z + r d) mod n
+      u64 km[4], kinv[4], rm[4], dm[4], zm[4], t[4], sm[4], s[4];
+      to_mont(FN, km, k);
+      mod_inv(FN, kinv, km);
+      to_mont(FN, rm, r);
+      to_mont(FN, dm, d);
+      to_mont(FN, zm, z);
+      mont_mul(FN, t, rm, dm);
+      mod_add(FN, t, t, zm);
+      mont_mul(FN, sm, kinv, t);
+      from_mont(FN, s, sm);
+      if (is_zero4(s)) goto retry;
+      u8 v = (u8)((ry[0] & 1) | (high_x ? 2 : 0));
+      // low-s normalization (flips the parity bit)
+      u64 half[4];
+      memcpy(half, FN.m, 32);
+      // n/2 (n odd -> floor)
+      for (int i = 0; i < 4; i++) {
+        half[i] >>= 1;
+        if (i < 3) half[i] |= FN.m[i + 1] << 63;
+      }
+      if (cmp4(s, half) > 0) {
+        u64 t2[4];
+        sub4(t2, FN.m, s);
+        memcpy(s, t2, 32);
+        v ^= 1;
+      }
+      store_be(sig, r);
+      store_be(sig + 32, s);
+      sig[64] = v;
+      return 0;
+    }
+  retry:
+    // mirror python: new nonce stream from sha256(orig_hash + extras)
+    extra += 1;
+    {
+      u8 buf[32 + 16];
+      memcpy(buf, hash, 32);
+      for (int i = 0; i < extra && i < 16; i++) buf[32 + i] = 0;
+      sha256(buf, 32 + (size_t)(extra < 16 ? extra : 16), cur_hash);
+    }
+  }
+}
+
+// returns 1 valid, 0 invalid
+int lt_ec_verify(const u8 pub[33], const u8 hash[32], const u8 *sig,
+                 size_t siglen) {
+  if (siglen != 65) return 0;
+  Pt q;
+  if (!pt_decompress(q, pub)) return 0;
+  u64 r[4], s[4], z[4];
+  load_be(r, sig);
+  load_be(s, sig + 32);
+  if (is_zero4(r) || is_zero4(s)) return 0;
+  if (cmp4(r, FN.m) >= 0 || cmp4(s, FN.m) >= 0) return 0;
+  load_be(z, hash);
+  if (cmp4(z, FN.m) >= 0) {
+    u64 t[4];
+    sub4(t, z, FN.m);
+    memcpy(z, t, 32);
+  }
+  u64 sm[4], sinv[4], zm[4], rm[4], u1m[4], u2m[4], u1[4], u2[4];
+  to_mont(FN, sm, s);
+  mod_inv(FN, sinv, sm);
+  to_mont(FN, zm, z);
+  to_mont(FN, rm, r);
+  mont_mul(FN, u1m, zm, sinv);
+  mont_mul(FN, u2m, rm, sinv);
+  from_mont(FN, u1, u1m);
+  from_mont(FN, u2, u2m);
+  Pt g, p1, p2, sum;
+  gen_pt(g);
+  pt_mul(p1, g, u1);
+  pt_mul(p2, q, u2);
+  pt_add(sum, p1, p2);
+  u64 ax[4], ay[4];
+  if (!pt_affine(ax, ay, sum)) return 0;
+  if (cmp4(ax, FN.m) >= 0) {
+    u64 t[4];
+    sub4(t, ax, FN.m);
+    memcpy(ax, t, 32);
+  }
+  return cmp4(ax, r) == 0 ? 1 : 0;
+}
+
+// returns 0 ok; out = compressed recovered pubkey
+int lt_ec_recover(const u8 hash[32], const u8 *sig, size_t siglen,
+                  u8 out[33]) {
+  if (siglen != 65) return 1;
+  u64 r[4], s[4];
+  load_be(r, sig);
+  load_be(s, sig + 32);
+  u8 v = sig[64];
+  if (v > 3) return 1;
+  if (is_zero4(r) || is_zero4(s)) return 1;
+  if (cmp4(r, FN.m) >= 0 || cmp4(s, FN.m) >= 0) return 1;
+  // x = r + (v & 2 ? n : 0)
+  u64 x[4];
+  memcpy(x, r, 32);
+  if (v & 2) {
+    if (add4(x, x, FN.m)) return 1;  // overflow past 2^256
+  }
+  if (cmp4(x, FP.m) >= 0) return 1;
+  // build compressed candidate point with parity v&1
+  u8 comp[33];
+  comp[0] = 0x02 | (v & 1);
+  store_be(comp + 1, x);
+  Pt rp;
+  if (!pt_decompress(rp, comp)) return 1;
+  u64 z[4];
+  load_be(z, hash);
+  if (cmp4(z, FN.m) >= 0) {
+    u64 t[4];
+    sub4(t, z, FN.m);
+    memcpy(z, t, 32);
+  }
+  // q = r^-1 (s R - z G)
+  u64 rm[4], rinv[4], sm2[4], zm[4], nm_z[4], t[4];
+  to_mont(FN, rm, r);
+  mod_inv(FN, rinv, rm);
+  to_mont(FN, sm2, s);
+  to_mont(FN, zm, z);
+  // n - z (plain)
+  u64 nz[4];
+  sub4(nz, FN.m, z);
+  if (is_zero4(z)) memset(nz, 0, 32);
+  Pt sR, zG, g, sum, q;
+  pt_mul(sR, rp, s);
+  gen_pt(g);
+  pt_mul(zG, g, nz);
+  pt_add(sum, sR, zG);
+  // multiply by r^-1 (plain form scalar)
+  u64 rinv_plain[4];
+  from_mont(FN, rinv_plain, rinv);
+  pt_mul(q, sum, rinv_plain);
+  u64 ax[4], ay[4];
+  if (!pt_affine(ax, ay, q)) return 1;
+  out[0] = 0x02 | (u8)(ay[0] & 1);
+  store_be(out + 1, ax);
+  (void)zm;
+  (void)t;
+  (void)nm_z;
+  return 0;
+}
+
+}  // extern "C"

@@ -23,8 +23,17 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libbls381.so")
 
 
 def _build_if_needed() -> None:
-    src = os.path.join(_NATIVE_DIR, "bls381.cpp")
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+    # rebuild when ANY source is newer than the .so — a stale library built
+    # before a source file was added would load fine (lt_version exists)
+    # but lack newer symbols, crashing callers with AttributeError
+    import glob
+
+    sources = glob.glob(os.path.join(_NATIVE_DIR, "*.cpp")) + [
+        os.path.join(_NATIVE_DIR, "Makefile")
+    ]
+    if os.path.exists(_LIB_PATH) and all(
+        os.path.getmtime(_LIB_PATH) >= os.path.getmtime(s) for s in sources
+    ):
         return
     subprocess.run(
         ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True

@@ -34,6 +34,8 @@ class EntryPrefix(enum.IntEnum):
     VALIDATOR_ATTENDANCE = 0x0701
     LOCAL_TRANSACTION = 0x0801
     CONSENSUS_STATE = 0x0901
+    SHRINK_STATE = 0x0A01
+    SHRINK_MARK = 0x0A02
 
 
 def prefixed(prefix: EntryPrefix, key: bytes = b"") -> bytes:

@@ -1,0 +1,155 @@
+"""DbShrink: prune trie nodes unreachable from recent checkpoints.
+
+Parity with the reference's DbShrink
+(/root/reference/src/Lachain.Storage/DbCompact/DbShrink.cs:118-203 +
+DbShrinkRepository.cs): the content-addressed trie never garbage-collects on
+its own — every historical root keeps its nodes alive — so long-running
+nodes prune snapshots older than a retention depth with a staged,
+RESUMABLE mark-and-sweep:
+
+  stage MARK   — walk every retained root (heights in [cutoff, tip]) and
+                 persist a mark entry per reachable node hash; progress is
+                 checkpointed per height so a crash resumes where it left
+  stage SWEEP  — scan all trie nodes, delete unmarked ones
+  stage CLEAN  — drop the mark entries + stale snapshot-index rows
+
+The stage and cursor live in the KV (SHRINK_STATE), mirroring the
+reference's DbShrinkStatus/DbShrinkDepositBlock bookkeeping.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from ..utils.serialization import write_u64
+from .kv import EntryPrefix, KVStore, prefixed
+from .state import StateManager, StateRoots
+from .trie import EMPTY_ROOT, InternalNode, LeafNode
+
+logger = logging.getLogger(__name__)
+
+_STATE_KEY = prefixed(EntryPrefix.SHRINK_STATE)
+_MARK = EntryPrefix.SHRINK_MARK
+
+
+class DbShrink:
+    def __init__(self, state: StateManager, kv: KVStore):
+        self.state = state
+        self.kv = kv
+
+    # -- progress bookkeeping -----------------------------------------------
+
+    def _load_progress(self) -> Optional[dict]:
+        raw = self.kv.get(_STATE_KEY)
+        return json.loads(raw.decode()) if raw else None
+
+    def _save_progress(self, p: dict) -> None:
+        self.kv.put(_STATE_KEY, json.dumps(p).encode())
+
+    # -- the staged shrink ---------------------------------------------------
+
+    def shrink(self, retain_depth: int) -> dict:
+        """Prune everything below (tip - retain_depth). Safe to re-invoke
+        after a crash: resumes from the persisted stage/cursor. Returns
+        stats {marked, swept, cutoff}."""
+        tip = self.state.committed_height()
+        if tip is None:
+            return {"marked": 0, "swept": 0, "cutoff": 0}
+        progress = self._load_progress()
+        if progress is None:
+            cutoff = max(0, tip - retain_depth)
+            progress = {
+                "stage": "mark",
+                "cutoff": cutoff,
+                "tip": tip,
+                "next_height": cutoff,
+                "marked": 0,
+            }
+            self._save_progress(progress)
+        # a resumed run keeps its original CUTOFF (marks below it were never
+        # made) but must extend the mark range to the CURRENT tip: blocks
+        # committed between crash and resume would otherwise have their trie
+        # nodes swept as unmarked — corrupting the newest state. Extra
+        # marking is always safe; missing marks never are.
+        cutoff = progress["cutoff"]
+        if tip > progress["tip"]:
+            progress["tip"] = tip
+            self._save_progress(progress)
+        tip = progress["tip"]
+
+        if progress["stage"] == "mark":
+            for height in range(progress["next_height"], tip + 1):
+                roots = self.state.roots_at(height)
+                if roots is not None:
+                    progress["marked"] += self._mark_roots(roots)
+                progress["next_height"] = height + 1
+                self._save_progress(progress)  # per-height resume point
+            progress["stage"] = "sweep"
+            self._save_progress(progress)
+
+        if progress["stage"] == "sweep":
+            swept = self._sweep()
+            progress["swept"] = progress.get("swept", 0) + swept
+            progress["stage"] = "clean"
+            self._save_progress(progress)
+
+        if progress["stage"] == "clean":
+            self._clean_marks()
+            # drop pruned heights from the snapshot index
+            stale = []
+            for height in range(0, cutoff):
+                key = prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(height))
+                if self.kv.get(key) is not None:
+                    stale.append(key)
+            for key in stale:
+                self.kv.delete(key)
+            self.kv.delete(_STATE_KEY)
+
+        stats = {
+            "marked": progress.get("marked", 0),
+            "swept": progress.get("swept", 0),
+            "cutoff": cutoff,
+        }
+        logger.info("db shrink done: %s", stats)
+        return stats
+
+    # -- stages --------------------------------------------------------------
+
+    def _mark_roots(self, roots: StateRoots) -> int:
+        """DFS from every tree root of a snapshot; marks persisted in the KV
+        (a node already marked prunes the whole subtree walk — structural
+        sharing makes repeated roots cheap)."""
+        marked = 0
+        stack = [r for r in roots.all_roots() if r != EMPTY_ROOT]
+        while stack:
+            h = stack.pop()
+            mark_key = prefixed(_MARK, h)
+            if self.kv.get(mark_key) is not None:
+                continue
+            self.kv.put(mark_key, b"\x01")
+            marked += 1
+            node = self.state.trie._load(h)
+            if isinstance(node, InternalNode):
+                stack.extend(
+                    c for c in node.children if c != EMPTY_ROOT
+                )
+        return marked
+
+    def _sweep(self) -> int:
+        node_prefix = prefixed(EntryPrefix.TRIE_NODE)
+        doomed = []
+        for key, _ in self.kv.scan_prefix(node_prefix):
+            h = key[len(node_prefix):]
+            if self.kv.get(prefixed(_MARK, h)) is None:
+                doomed.append(key)
+        for key in doomed:
+            self.kv.delete(key)
+        # pruned nodes may still sit in the trie's LRU cache; a fresh run
+        # only ever reads retained roots, but drop the cache for hygiene
+        self.state.trie.clear_cache()
+        return len(doomed)
+
+    def _clean_marks(self) -> None:
+        for key, _ in list(self.kv.scan_prefix(prefixed(_MARK))):
+            self.kv.delete(key)

@@ -55,6 +55,9 @@ class StateRoots:
     def encode(self) -> bytes:
         return b"".join(getattr(self, name) for name in SUBTREES)
 
+    def all_roots(self) -> tuple:
+        return tuple(getattr(self, name) for name in SUBTREES)
+
     @classmethod
     def decode(cls, data: bytes) -> "StateRoots":
         assert len(data) == 32 * len(SUBTREES)

@@ -103,6 +103,9 @@ class Trie:
         self._cache_put(h, node)
         return node
 
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
     def _cache_put(self, h: bytes, node) -> None:
         self._cache[h] = node
         if len(self._cache) > self._cache_size:

@@ -74,9 +74,13 @@ def observe(name: str, seconds: float) -> None:
         _timers[name] = (cnt + 1, total + seconds)
 
 
-def timer_snapshot(reset: bool = False) -> Dict[str, dict]:
+def timer_snapshot(
+    reset: bool = False, reset_prefix: str = ""
+) -> Dict[str, dict]:
     """{name: {count, total_ms, avg_ms}} — the per-era dump
-    (DefaultCrypto.ResetBenchmark shape)."""
+    (DefaultCrypto.ResetBenchmark shape). With `reset_prefix`, only timers
+    whose name starts with it are cleared (the reference resets the CRYPTO
+    counters per era; block/RPC summaries must survive for scrapes)."""
     with _lock:
         snap = {
             name: {
@@ -87,7 +91,11 @@ def timer_snapshot(reset: bool = False) -> Dict[str, dict]:
             for name, (cnt, total) in _timers.items()
         }
         if reset:
-            _timers.clear()
+            if reset_prefix:
+                for name in [n for n in _timers if n.startswith(reset_prefix)]:
+                    del _timers[name]
+            else:
+                _timers.clear()
     return snap
 
 

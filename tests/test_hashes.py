@@ -51,3 +51,18 @@ def test_merkle_sizes():
         for i in range(n):
             proof = hashes.merkle_proof(leaves, i)
             assert hashes.merkle_verify(leaves[i], i, proof, root), (n, i)
+
+
+def test_native_keccak_matches_python():
+    import random
+
+    from lachain_tpu.crypto.hashes import _keccak256_py, _native_lib, keccak256
+
+    if _native_lib() is None:
+        import pytest
+
+        pytest.skip("native backend unavailable")
+    rng = random.Random(3)
+    for size in (0, 1, 31, 32, 135, 136, 137, 1000, 5000):
+        data = rng.randbytes(size)
+        assert keccak256(data) == _keccak256_py(data)
