@@ -168,8 +168,21 @@ def _build_node(cfg, config_path=None):
 
 async def _run_node(cfg, args) -> None:
     node, peers = _build_node(cfg, args.config)
-    await node.start()
+    want_fast = bool(getattr(args, "fast_sync", False)) and peers
+    await node.start(start_synchronizer=not want_fast)
     node.connect(peers)
+    if want_fast:
+        # reference Application.Start: FastSynchronizerBatch BEFORE the
+        # block synchronizer, so replay doesn't race the state download
+        await asyncio.sleep(1.0)  # let peer connections establish
+        for peer in peers:
+            try:
+                h = await node.fast_sync.sync(peer.public_key, timeout=120)
+                print(f"fast-synced to height {h}", flush=True)
+                break
+            except Exception as e:
+                logger.warning("fast sync via %s failed: %s", peer.host, e)
+        node.start_services()
     rpc = None
     if cfg.rpc.enabled:
         rpc = await node.start_rpc(
@@ -271,6 +284,11 @@ def main(argv=None) -> int:
     rn = sub.add_parser("run", help="run a node from a config")
     rn.add_argument("--config", required=True)
     rn.add_argument("--stake", help="stake this amount at startup")
+    rn.add_argument(
+        "--fast-sync",
+        action="store_true",
+        help="download state from a peer instead of replaying blocks",
+    )
     rn.set_defaults(fn=cmd_run)
 
     ht = sub.add_parser("height", help="print local chain status")

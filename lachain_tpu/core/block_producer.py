@@ -11,6 +11,7 @@ consensus layer free of chain-state knowledge.
 """
 from __future__ import annotations
 
+import random
 from typing import List, Optional, Sequence
 
 from ..utils.serialization import Reader, write_bytes_list
@@ -59,10 +60,8 @@ class BlockProducer:
 
     # -- proposal ---------------------------------------------------------------
     def get_transactions_to_propose(self) -> List[SignedTransaction]:
-        import random as _random
-
         rng = (
-            _random.Random((self.proposal_seed << 20) ^ self.bm.current_height())
+            random.Random((self.proposal_seed << 20) ^ self.bm.current_height())
             if self.proposal_seed >= 0
             else None
         )

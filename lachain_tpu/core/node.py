@@ -91,6 +91,11 @@ class Node:
         self.network.on_sync_pool_reply = self._on_pool_txs
         self.network.on_ping_request = self._on_ping_request
         self.validator_manager = ValidatorManager(self.state, public_keys)
+        from .fast_sync import FastSynchronizer
+
+        # serving + client side of trie-level fast state sync; every node
+        # serves (reference: peers answer state download RPCs)
+        self.fast_sync = FastSynchronizer(self)
         self.synchronizer = BlockSynchronizer(
             self.block_manager,
             self.pool,
@@ -136,13 +141,23 @@ class Node:
 
     # -- service lifecycle --------------------------------------------------
 
-    async def start(self, first_era: int = 1) -> None:
+    async def start(
+        self, first_era: int = 1, *, start_synchronizer: bool = True
+    ) -> None:
+        """With start_synchronizer=False only the network comes up — the
+        reference's fast-sync window (Application.Start runs
+        FastSynchronizerBatch BEFORE blockSynchronizer.Start, so replay
+        doesn't race the state download); call start_services() after."""
         await self.network.start()
         # the router exists before the era loop runs so consensus traffic
         # from faster peers is dispatched (or era-buffered), not dropped
         # (observers — index < 0 — only sync, never vote)
         if self.index >= 0:
             self._ensure_router(first_era)
+        if start_synchronizer:
+            self.start_services()
+
+    def start_services(self) -> None:
         self.synchronizer.start()
         self._watchdog_task = asyncio.get_running_loop().create_task(
             self._protocol_watchdog()

@@ -147,6 +147,7 @@ def _native_lib():
 @metrics.timed("crypto_ec_sign")
 def sign_hash(priv: bytes, msg_hash: bytes) -> bytes:
     """65-byte recoverable signature r(32) || s(32) || v(1), low-s enforced."""
+    assert len(msg_hash) == 32 and len(priv) == 32
     lib = _native_lib()
     if lib is not None:
         import ctypes as _ct
@@ -185,7 +186,7 @@ def _sign_hash_py(priv: bytes, msg_hash: bytes) -> bytes:
 @metrics.timed("crypto_ec_verify")
 def verify_hash(pub: bytes, msg_hash: bytes, sig: bytes) -> bool:
     lib = _native_lib()
-    if lib is not None and len(pub) == 33:
+    if lib is not None and len(pub) == 33 and len(msg_hash) == 32:
         return bool(lib.lt_ec_verify(pub, msg_hash, sig, len(sig)))
     return _verify_hash_py(pub, msg_hash, sig)
 
@@ -262,7 +263,7 @@ def ecies_decrypt(priv: bytes, data: bytes) -> bytes:
 def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     """Recover the compressed public key from a 65-byte signature."""
     lib = _native_lib()
-    if lib is not None:
+    if lib is not None and len(msg_hash) == 32:
         import ctypes as _ct
 
         out = (_ct.c_ubyte * 33)()

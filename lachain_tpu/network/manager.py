@@ -41,6 +41,10 @@ class NetworkManager:
         self.on_ping_request: Optional[Callable[[bytes, int], None]] = None
         self.on_ping_reply: Optional[Callable[[bytes, int], None]] = None
         self.on_sync_blocks_request: Optional[Callable] = None
+        self.on_fast_sync_request: Optional[Callable] = None
+        self.on_fast_sync_reply: Optional[Callable] = None
+        self.on_trie_nodes_request: Optional[Callable] = None
+        self.on_trie_nodes_reply: Optional[Callable] = None
         self.on_sync_blocks_reply: Optional[Callable] = None
         self.on_sync_pool_request: Optional[Callable] = None
         self.on_sync_pool_reply: Optional[Callable] = None
@@ -128,3 +132,11 @@ class NetworkManager:
             self.on_sync_pool_request(sender, wire.parse_sync_pool_request(msg))
         elif k == wire.KIND_SYNC_POOL_REPLY and self.on_sync_pool_reply:
             self.on_sync_pool_reply(sender, wire.parse_sync_pool_reply(msg))
+        elif k == wire.KIND_FAST_SYNC_REQUEST and self.on_fast_sync_request:
+            self.on_fast_sync_request(sender, wire.parse_fast_sync_request(msg))
+        elif k == wire.KIND_FAST_SYNC_REPLY and self.on_fast_sync_reply:
+            self.on_fast_sync_reply(sender, *wire.parse_fast_sync_reply(msg))
+        elif k == wire.KIND_TRIE_NODES_REQUEST and self.on_trie_nodes_request:
+            self.on_trie_nodes_request(sender, wire.parse_trie_nodes_request(msg))
+        elif k == wire.KIND_TRIE_NODES_REPLY and self.on_trie_nodes_reply:
+            self.on_trie_nodes_reply(sender, wire.parse_trie_nodes_reply(msg))

@@ -155,12 +155,20 @@ KIND_SYNC_BLOCKS_REQUEST = 4
 KIND_SYNC_BLOCKS_REPLY = 5
 KIND_SYNC_POOL_REQUEST = 6
 KIND_SYNC_POOL_REPLY = 7
+KIND_FAST_SYNC_REQUEST = 8
+KIND_FAST_SYNC_REPLY = 9
+KIND_TRIE_NODES_REQUEST = 10
+KIND_TRIE_NODES_REPLY = 11
 
 # reference NetworkMessagePriority: replies < consensus < pool sync
 PRIORITY = {
     KIND_PING_REPLY: 0,
     KIND_SYNC_BLOCKS_REPLY: 0,
     KIND_SYNC_POOL_REPLY: 0,
+    KIND_FAST_SYNC_REQUEST: 2,
+    KIND_FAST_SYNC_REPLY: 0,
+    KIND_TRIE_NODES_REQUEST: 2,
+    KIND_TRIE_NODES_REPLY: 0,
     KIND_CONSENSUS: 1,
     KIND_PING_REQUEST: 2,
     KIND_SYNC_BLOCKS_REQUEST: 2,
@@ -319,3 +327,46 @@ class MessageFactory:
         return MessageBatch(
             sender=self.public_key, signature=sig, content=content
         )
+
+
+# -- fast state sync (reference FastSynchronizerBatch / StateDownloader) -----
+
+
+def fast_sync_request(height: int) -> NetworkMessage:
+    """Ask for the block + state roots at `height` (0 = serving peer's tip)."""
+    return NetworkMessage(KIND_FAST_SYNC_REQUEST, write_u64(height))
+
+
+def parse_fast_sync_request(msg: NetworkMessage) -> int:
+    return Reader(msg.body).u64()
+
+
+def fast_sync_reply(block: Optional[Block], roots_enc: bytes) -> NetworkMessage:
+    body = write_bytes(block.encode() if block else b"") + write_bytes(roots_enc)
+    return NetworkMessage(KIND_FAST_SYNC_REPLY, body)
+
+
+def parse_fast_sync_reply(msg: NetworkMessage):
+    r = Reader(msg.body)
+    raw = r.bytes_()
+    block = Block.decode(raw) if raw else None
+    return block, r.bytes_()
+
+
+def trie_nodes_request(hashes: List[bytes]) -> NetworkMessage:
+    return NetworkMessage(KIND_TRIE_NODES_REQUEST, write_bytes_list(hashes))
+
+
+def parse_trie_nodes_request(msg: NetworkMessage) -> List[bytes]:
+    return Reader(msg.body).bytes_list()
+
+
+def trie_nodes_reply(nodes: List[bytes]) -> NetworkMessage:
+    """Node encodings only: receivers verify content-addressing
+    (keccak(node) must equal the requested hash), so replies are
+    trustless."""
+    return NetworkMessage(KIND_TRIE_NODES_REPLY, write_bytes_list(nodes))
+
+
+def parse_trie_nodes_reply(msg: NetworkMessage) -> List[bytes]:
+    return Reader(msg.body).bytes_list()

@@ -22,7 +22,6 @@ import json
 import logging
 from typing import Optional
 
-from ..utils.serialization import write_u64
 from .kv import EntryPrefix, KVStore, prefixed
 from .state import StateManager, StateRoots
 from .trie import EMPTY_ROOT, InternalNode, LeafNode
@@ -74,7 +73,13 @@ class DbShrink:
         # marking is always safe; missing marks never are.
         cutoff = progress["cutoff"]
         if tip > progress["tip"]:
+            old_tip = progress["tip"]
             progress["tip"] = tip
+            if progress["stage"] != "mark":
+                # the sweep/clean stages must never run with unmarked recent
+                # heights: fall back to marking the delta first
+                progress["stage"] = "mark"
+                progress["next_height"] = old_tip + 1
             self._save_progress(progress)
         tip = progress["tip"]
 
@@ -96,11 +101,14 @@ class DbShrink:
 
         if progress["stage"] == "clean":
             self._clean_marks()
-            # drop pruned heights from the snapshot index
+            # drop pruned heights from the snapshot index: scan live index
+            # rows (O(retained) after the first shrink) instead of probing
+            # every height since genesis
+            idx_prefix = prefixed(EntryPrefix.SNAPSHOT_INDEX)
             stale = []
-            for height in range(0, cutoff):
-                key = prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(height))
-                if self.kv.get(key) is not None:
+            for key, _ in self.kv.scan_prefix(idx_prefix):
+                height = int.from_bytes(key[len(idx_prefix):], "big")
+                if height < cutoff:
                     stale.append(key)
             for key in stale:
                 self.kv.delete(key)

@@ -1,0 +1,134 @@
+"""Fast state sync: a fresh node reaches the chain head by downloading the
+trie, not replaying blocks (reference FastSynchronizerBatch.cs /
+StateDownloader.cs)."""
+import asyncio
+import random
+
+import pytest
+
+from lachain_tpu.consensus.keys import PrivateConsensusKeys, trusted_key_gen
+from lachain_tpu.core import execution
+from lachain_tpu.core.node import Node
+from lachain_tpu.core.types import Transaction, sign_transaction
+from lachain_tpu.crypto import ecdsa
+
+CHAIN = 733
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+@pytest.mark.slow
+def test_fresh_node_fast_syncs_state_then_follows():
+    n, f = 4, 1
+    pub, privs = trusted_key_gen(n, f, rng=Rng(21))
+    user = ecdsa.generate_private_key(Rng(5))
+    uaddr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(user))
+    dest = b"\x0c" * 20
+    genesis = {uaddr: 10**20}
+
+    async def main():
+        validators = [
+            Node(
+                index=i, public_keys=pub, private_keys=privs[i],
+                chain_id=CHAIN, initial_balances=genesis, flush_interval=0.01,
+            )
+            for i in range(n)
+        ]
+        for node in validators:
+            await node.start()
+        addrs = [node.address for node in validators]
+        for node in validators:
+            node.connect(addrs)
+
+        # build an 8-block chain with real state changes
+        for era in range(1, 9):
+            stx = sign_transaction(
+                Transaction(
+                    to=dest, value=10, nonce=era - 1, gas_price=1,
+                    gas_limit=21000,
+                ),
+                user, CHAIN,
+            )
+            validators[0].submit_tx(stx)
+            await asyncio.sleep(0.05)
+            await asyncio.gather(*(v.run_era(era) for v in validators))
+
+        # fresh observer: genesis only
+        observer = Node(
+            index=-1, public_keys=pub,
+            private_keys=PrivateConsensusKeys.observer(
+                ecdsa.generate_private_key(Rng(99))
+            ),
+            chain_id=CHAIN, initial_balances=genesis, flush_interval=0.01,
+        )
+        # reference sequencing: fast sync runs BEFORE the block
+        # synchronizer starts, so replay doesn't race the state download
+        await observer.start(start_synchronizer=False)
+        observer.connect(addrs)
+        for v in validators:
+            v.connect([observer.address])
+
+        fs = observer.fast_sync
+        peer_pub = pub.ecdsa_pub_keys[0]
+        synced = await fs.sync(peer_pub, timeout=30)
+        observer.start_services()
+        assert synced == 8
+        assert observer.block_manager.current_height() == 8
+        # the downloaded STATE is complete and correct — without replay
+        snap = observer.state.new_snapshot()
+        assert execution.get_balance(snap, dest) == 80
+        assert execution.get_nonce(snap, uaddr) == 8
+        # blocks 1..7 were never downloaded (that's the point)
+        assert observer.block_manager.block_by_height(3) is None
+        assert observer.block_manager.block_by_height(8) is not None
+
+        # and normal sync continues from the fast-synced head
+        await asyncio.gather(*(v.run_era(9) for v in validators))
+        await observer.synchronizer.wait_for_height(9, timeout=30)
+        assert (
+            observer.block_manager.block_by_height(9).hash()
+            == validators[0].block_manager.block_by_height(9).hash()
+        )
+
+        # a tampered reply is rejected: wrong roots for the header
+        for node in validators + [observer]:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_fast_sync_rejects_mismatched_roots():
+    """Roots that do not hash to the block header's state_hash are refused
+    (the trust anchor of the download)."""
+    from lachain_tpu.storage.state import StateRoots
+
+    n, f = 4, 1
+    pub, privs = trusted_key_gen(n, f, rng=Rng(3))
+
+    async def main():
+        node = Node(
+            index=0, public_keys=pub, private_keys=privs[0],
+            chain_id=CHAIN, initial_balances={}, flush_interval=0.01,
+        )
+        await node.start()
+        fs = node.fast_sync
+        block = node.block_manager.block_by_height(0)
+        bogus = StateRoots(balances=b"\x11" * 32)
+
+        def fake_send(pub, msg):
+            # peer answers with roots that do not match the header
+            fs._reply = (block, bogus.encode())
+            fs._reply_event.set()
+
+        node.network.send_to = fake_send
+        with pytest.raises(ValueError, match="roots do not match"):
+            await fs.sync(b"\x02" + b"\x00" * 32, timeout=5)
+        await node.stop()
+
+    asyncio.run(main())
