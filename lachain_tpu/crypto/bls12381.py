@@ -28,6 +28,7 @@ Design notes
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 import math
 from typing import Iterable, List, Optional, Sequence, Tuple
@@ -797,7 +798,16 @@ def fr_eval_poly(coeffs: Sequence[int], x: int) -> int:
 
 
 def fr_lagrange_coeffs(xs: Sequence[int], at: int = 0) -> List[int]:
-    """Lagrange basis coefficients l_i(at) for interpolation points xs mod r."""
+    """Lagrange basis coefficients l_i(at) for interpolation points xs mod r.
+
+    Cached per (xs, at): the per-era combine repeatedly interpolates over
+    the SAME share subset (typically the fastest f+1 responders), and the
+    O(n^2) modular inversions otherwise sit on the era hot path."""
+    return list(_lagrange_cached(tuple(xs), at))
+
+
+@functools.lru_cache(maxsize=256)
+def _lagrange_cached(xs: tuple, at: int) -> tuple:
     n = len(xs)
     assert len(set(x % R for x in xs)) == n, "duplicate interpolation points"
     coeffs = []
@@ -809,7 +819,7 @@ def fr_lagrange_coeffs(xs: Sequence[int], at: int = 0) -> List[int]:
             num = num * ((at - xs[j]) % R) % R
             den = den * ((xs[i] - xs[j]) % R) % R
         coeffs.append(num * pow(den, R - 2, R) % R)
-    return coeffs
+    return tuple(coeffs)
 
 
 def fr_interpolate(xs: Sequence[int], ys: Sequence[int], at: int = 0) -> int:
