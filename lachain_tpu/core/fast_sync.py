@@ -56,6 +56,7 @@ class FastSynchronizer:
         self.trusted = trusted
         self.batch = batch
         self._reply: Optional[Tuple[Optional[Block], bytes]] = None
+        self._peer: Optional[bytes] = None  # peer of the in-flight sync
         self._nodes_event = asyncio.Event()
         self._reply_event = asyncio.Event()
         self._received: List[bytes] = []
@@ -92,10 +93,18 @@ class FastSynchronizer:
     # -- client side ---------------------------------------------------------
 
     def _on_fast_sync_reply(self, sender, block, roots_enc) -> None:
+        # only the peer we asked, and only while a request is in flight —
+        # any other connected peer could otherwise inject a stale-but-signed
+        # snapshot (pinning a fresh node asking for height=0 to old state)
+        # or poison the node download into a spurious abort
+        if self._peer is None or sender != self._peer or self._reply_event.is_set():
+            return
         self._reply = (block, roots_enc)
         self._reply_event.set()
 
     def _on_trie_nodes_reply(self, sender, nodes: List[bytes]) -> None:
+        if self._peer is None or sender != self._peer:
+            return
         self._received.extend(nodes)
         self._nodes_event.set()
 
@@ -106,7 +115,15 @@ class FastSynchronizer:
         Returns the synced height. Raises on verification failure."""
         node = self.node
         self._reply = None
+        self._peer = peer_pub
         self._reply_event.clear()
+        try:
+            return await self._sync_inner(peer_pub, height, timeout)
+        finally:
+            self._peer = None  # stop accepting replies once the sync ends
+
+    async def _sync_inner(self, peer_pub: bytes, height: int, timeout: float) -> int:
+        node = self.node
         node.network.send_to(peer_pub, wire.fast_sync_request(height))
         await asyncio.wait_for(self._reply_event.wait(), timeout)
         block, roots_enc = self._reply or (None, b"")

@@ -5,10 +5,14 @@ Parity with the reference's ECDSA surface
 Secp256k1.Net): transaction + consensus-header signatures with public-key
 recovery, 65-byte (r || s || v) signatures, Ethereum-style addresses.
 
-Pure Python (curve ops on ints). Not constant-time — acceptable for a
-devnet node signing its own public messages; the native C++ port is the
-hardening path (tracked for a later round alongside batch ECDSA recovery,
-the "vmapped TransactionVerifier" candidate from SURVEY.md §2a).
+Pure Python (curve ops on ints). SIGNING IS NOT CONSTANT-TIME on either
+backend: both this oracle and the C++ port use branchy double-and-add over
+the secret nonce, so timing/cache side channels can leak nonce bits of a
+frequently-signing key (lattice attacks). Both are therefore DEVNET-GRADE
+for signing; verification/recovery take only public inputs and are
+unaffected. A production deployment must swap sign_hash for a
+constant-time implementation (complete formulas + branchless window
+selection) before exposing validator keys to co-located adversaries.
 """
 from __future__ import annotations
 
@@ -83,7 +87,14 @@ def public_key_bytes(priv: bytes) -> bytes:
 
 
 def decompress_public_key(pub: bytes) -> Tuple[int, int]:
-    assert len(pub) == 33 and pub[0] in (2, 3)
+    # ValueError (not assert) so malformed keys from untrusted input —
+    # contract crypto_verify calls, wire MessageBatch senders — are a
+    # clean "invalid" on every backend: the native lt_ec_verify returns
+    # false for a non-02/03 prefix, and _verify_hash_py catches ValueError.
+    # An AssertionError here would trap python-backend nodes while native
+    # nodes return 0, forking state across a mixed deployment.
+    if len(pub) != 33 or pub[0] not in (2, 3):
+        raise ValueError("pubkey must be 33 bytes with 02/03 prefix")
     x = int.from_bytes(pub[1:], "big")
     if x >= P:
         raise ValueError("pubkey x out of range")

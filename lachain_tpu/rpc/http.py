@@ -8,6 +8,7 @@ directly on asyncio streams — the framework keeps zero HTTP dependencies.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import logging
 from typing import Callable, Dict, Optional
@@ -15,6 +16,9 @@ from typing import Callable, Dict, Optional
 logger = logging.getLogger(__name__)
 
 MAX_BODY = 4 << 20
+MAX_HEADERS = 128           # header lines per request
+READ_TIMEOUT = 30.0         # seconds per read — kills slowloris holders
+MAX_REQUESTS_PER_CONN = 1024  # bound keep-alive connection lifetime
 
 
 class JsonRpcError(Exception):
@@ -64,9 +68,14 @@ class JsonRpcServer:
     # -- HTTP plumbing ------------------------------------------------------
 
     async def _handle_conn(self, reader, writer) -> None:
+        async def read(coro):
+            # every read is deadlined: a client dribbling bytes (slowloris)
+            # otherwise parks this task forever and drains the server
+            return await asyncio.wait_for(coro, READ_TIMEOUT)
+
         try:
-            while True:
-                line = await reader.readline()
+            for _ in range(MAX_REQUESTS_PER_CONN):
+                line = await read(reader.readline())
                 if not line:
                     return
                 try:
@@ -74,20 +83,27 @@ class JsonRpcServer:
                 except (ValueError, UnicodeDecodeError):
                     return
                 headers = {}
-                while True:
-                    h = await reader.readline()
+                for _h in range(MAX_HEADERS):
+                    h = await read(reader.readline())
                     if h in (b"\r\n", b"\n", b""):
                         break
                     k, _, v = h.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
-                length = int(headers.get("content-length", "0"))
-                if length > MAX_BODY:
+                else:
+                    return  # header flood
+                try:
+                    length = int(headers.get("content-length", "0"))
+                except ValueError:
+                    return
+                if length < 0 or length > MAX_BODY:
                     await self._respond(writer, 413, b"body too large")
                     return
-                body = await reader.readexactly(length) if length else b""
-                if self.api_key is not None and headers.get(
-                    "x-api-key"
-                ) != self.api_key:
+                body = await read(reader.readexactly(length)) if length else b""
+                # compare as bytes: compare_digest on str raises TypeError
+                # for non-ASCII input, which would be attacker-drivable
+                if self.api_key is not None and not hmac.compare_digest(
+                    headers.get("x-api-key", "").encode(), self.api_key.encode()
+                ):
                     # key gates EVERYTHING, including the metrics scrape
                     await self._respond(writer, 403, b"bad api key")
                     continue
@@ -112,7 +128,7 @@ class JsonRpcServer:
                 )
                 if headers.get("connection", "").lower() == "close":
                     return
-        except (asyncio.IncompleteReadError, ConnectionError):
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.TimeoutError):
             pass
         except Exception:
             logger.exception("rpc connection handler failed")
@@ -132,7 +148,9 @@ class JsonRpcServer:
             f"Content-Length: {len(body)}\r\n"
             "\r\n".encode() + body
         )
-        await writer.drain()
+        # deadlined like the reads: a client that never drains its socket
+        # would otherwise park this task on a full write buffer forever
+        await asyncio.wait_for(writer.drain(), READ_TIMEOUT)
 
     # -- JSON-RPC semantics --------------------------------------------------
 

@@ -84,17 +84,30 @@ class DbShrink:
         tip = progress["tip"]
 
         if progress["stage"] == "mark":
-            for height in range(progress["next_height"], tip + 1):
-                roots = self.state.roots_at(height)
-                if roots is not None:
-                    progress["marked"] += self._mark_roots(roots)
-                progress["next_height"] = height + 1
-                self._save_progress(progress)  # per-height resume point
+            while True:
+                for height in range(progress["next_height"], tip + 1):
+                    roots = self.state.roots_at(height)
+                    if roots is not None:
+                        progress["marked"] += self._mark_roots(roots)
+                    progress["next_height"] = height + 1
+                    self._save_progress(progress)  # per-height resume point
+                # Re-check the tip before committing to sweep: marking takes
+                # real time, and a block committed meanwhile (threaded caller,
+                # CLI racing a live node) would have its nodes swept as
+                # unmarked. Loop until the tip is stable across a full mark
+                # pass — the same extend-don't-shrink rule as the resume path.
+                # shrink() itself is synchronous, so an in-event-loop caller
+                # cannot be raced past this point.
+                new_tip = self.state.committed_height()
+                if new_tip is None or new_tip <= tip:
+                    break
+                progress["tip"] = tip = new_tip
+                self._save_progress(progress)
             progress["stage"] = "sweep"
             self._save_progress(progress)
 
         if progress["stage"] == "sweep":
-            swept = self._sweep()
+            swept = self._sweep(progress)
             progress["swept"] = progress.get("swept", 0) + swept
             progress["stage"] = "clean"
             self._save_progress(progress)
@@ -144,13 +157,32 @@ class DbShrink:
                 )
         return marked
 
-    def _sweep(self) -> int:
+    def _sweep(self, progress: dict) -> int:
         node_prefix = prefixed(EntryPrefix.TRIE_NODE)
         doomed = []
         for key, _ in self.kv.scan_prefix(node_prefix):
             h = key[len(node_prefix):]
             if self.kv.get(prefixed(_MARK, h)) is None:
                 doomed.append(key)
+        # the scan takes real time too: a block committed during it (threaded
+        # caller) has unmarked nodes sitting in `doomed`. Mark the tip delta
+        # now and drop the newly marked keys before deleting. A commit landing
+        # after THIS point and before the deletes finish is out of scope —
+        # shrink() must not race commits from another thread/process past
+        # here (the KV is single-writer; the node calls shrink on its own
+        # event-loop thread where the whole run is atomic).
+        new_tip = self.state.committed_height()
+        if new_tip is not None and new_tip > progress["tip"]:
+            for height in range(progress["tip"] + 1, new_tip + 1):
+                roots = self.state.roots_at(height)
+                if roots is not None:
+                    progress["marked"] += self._mark_roots(roots)
+            progress["tip"] = new_tip
+            self._save_progress(progress)
+            doomed = [
+                k for k in doomed
+                if self.kv.get(prefixed(_MARK, k[len(node_prefix):])) is None
+            ]
         for key in doomed:
             self.kv.delete(key)
         # pruned nodes may still sit in the trie's LRU cache; a fresh run

@@ -75,6 +75,26 @@ def test_malformed_inputs():
     assert ec.recover_hash(h, b"short") is None
 
 
+def test_malformed_pubkey_prefix_agrees_across_backends():
+    """A garbage pubkey (bad prefix byte, wrong length) must be a clean
+    False on BOTH backends — never an exception. A python-node trap where
+    a native node returns 0 would fork state on contract crypto_verify
+    (ADVICE round 2, high)."""
+    priv = ec.generate_private_key(Rng(11))
+    h = keccak256(b"payload")
+    sig = ec.sign_hash(priv, h)
+    for bad_pub in (
+        b"\x04" + b"\x11" * 32,   # uncompressed prefix, 33 bytes
+        b"\x00" + b"\x11" * 32,   # zero prefix
+        b"\xff" + b"\x11" * 32,   # junk prefix
+        b"\x02" + b"\x11" * 31,   # short
+        b"\x02" + b"\x11" * 40,   # long
+        b"",                       # empty
+    ):
+        assert ec._verify_hash_py(bad_pub, h, sig) is False
+        assert ec.verify_hash(bad_pub, h, sig) is False
+
+
 def test_native_backend_matches_python_oracle():
     """The C++ secp256k1 backend must be byte-identical to the pure-Python
     oracle on sign/verify/recover (round-2 native TransactionVerifier
