@@ -52,8 +52,9 @@ def main() -> None:
     from lachain_tpu.crypto import bls12381 as bls
     from lachain_tpu.crypto import tpke
     from lachain_tpu.crypto.native_backend import NativeBackend
-    from lachain_tpu.ops.verify import GlvEraPipeline
+    from lachain_tpu.ops.verify import GlvEraPipeline, PallasEraPipeline
 
+    impl = os.environ.get("LTPU_BENCH_IMPL", "pallas")
     backend = NativeBackend()
     dealer = tpke.TpkeTrustedKeyGen(n, f, rng=Rng())
 
@@ -91,8 +92,12 @@ def main() -> None:
     baseline_s = total_shares * per_share_s + n * per_combine_s
 
     # ---- TPU batched path ---------------------------------------------------
-    pipeline = GlvEraPipeline(backend)
-    pipeline.y_device(y_points)  # cache the era-invariant key marshal
+    if impl == "pallas":
+        pipeline = PallasEraPipeline(backend)
+        pipeline.y_device(y_points, n)  # cache the era-invariant key marshal
+    else:
+        pipeline = GlvEraPipeline(backend)
+        pipeline.y_device(y_points)
 
     def era_slots():
         """Per-era kernel inputs: share points + Lagrange coefficient rows

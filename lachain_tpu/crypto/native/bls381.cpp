@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 typedef uint64_t u64;
@@ -1006,140 +1007,187 @@ static void fp12_mul_sparse(Fp12 &f, const Fp2 &A, const Fp2 &B,
   f.c1 = r1;
 }
 
+struct MLState {
+  Fp px, py;
+  Fp2 xQ, yQ, X, Y, Z;
+  bool inf;
+};
+
+static void ml_init(MLState &s, const G1 &p, const G2 &q) {
+  s.inf = g1_is_inf(p) || g2_is_inf(q);
+  if (s.inf) return;
+  g1_to_affine(s.px, s.py, p);
+  g2_to_affine(s.xQ, s.yQ, q);
+  s.X = s.xQ;
+  s.Y = s.yQ;
+  s.Z = FP2_ONE_;
+}
+
+// one doubling step of the shared-squaring Miller loop: accumulate this
+// pair's line into f (caller has already squared f ONCE for all pairs)
+static void ml_dbl_step(MLState &s, Fp12 &f) {
+  if (s.inf) return;
+  const Fp &px = s.px, &py = s.py;
+  Fp2 &X = s.X, &Y = s.Y, &Z = s.Z;
+  Fp2 A, B, C, t, t2;
+  // --- doubling step: line scaled by 2YZ^2 ---
+  Fp2 XX, YY, X3c, YZ, YYZ;
+  fp2_sqr(XX, X);
+  fp2_sqr(YY, Y);
+  fp2_mul(X3c, X, XX);  // X^3
+  fp2_mul(YZ, Y, Z);
+  fp2_mul(YYZ, YY, Z);
+  // A = 3X^3 - 2Y^2Z
+  fp2_add(t, X3c, X3c);
+  fp2_add(A, t, X3c);
+  fp2_add(t, YYZ, YYZ);
+  fp2_sub(A, A, t);
+  // B = -3*X^2*Z*px
+  Fp2 XXZ;
+  fp2_mul(XXZ, XX, Z);
+  fp2_add(t, XXZ, XXZ);
+  fp2_add(t, t, XXZ);
+  fp_mul(B.c0, t.c0, px);
+  fp_mul(B.c1, t.c1, px);
+  fp2_neg(B, B);
+  // C = 2*Y*Z^2*py
+  Fp2 YZZ;
+  fp2_mul(YZZ, YZ, Z);
+  fp2_add(t, YZZ, YZZ);
+  fp_mul(C.c0, t.c0, py);
+  fp_mul(C.c1, t.c1, py);
+  fp12_mul_sparse(f, A, B, C);
+  // T = 2T:  X3 = 2XYZ(9X^3 - 8Y^2Z); Y3 = 36X^3*YYZ - 27X^6 - 8(YYZ)^2;
+  //          Z3 = 8(YZ)^3
+  Fp2 XYZ, nine_x3, eight_yyz, X3n, Y3n, Z3n, x3sq, yyzsq, yz2;
+  fp2_mul(XYZ, X, YZ);
+  fp2_add(t, X3c, X3c);          // 2X^3
+  fp2_add(t2, t, t);             // 4X^3
+  fp2_add(t2, t2, t2);           // 8X^3
+  fp2_add(nine_x3, t2, X3c);     // 9X^3
+  fp2_add(t, YYZ, YYZ);          // 2YYZ
+  fp2_add(t2, t, t);             // 4YYZ
+  fp2_add(eight_yyz, t2, t2);    // 8YYZ
+  fp2_sub(t, nine_x3, eight_yyz);
+  fp2_mul(X3n, XYZ, t);
+  fp2_add(X3n, X3n, X3n);
+  fp2_sqr(x3sq, X3c);            // X^6
+  fp2_sqr(yyzsq, YYZ);
+  fp2_mul(t, X3c, YYZ);          // X^3*Y^2*Z
+  Fp2 acc;
+  fp2_add(acc, t, t);            // 2
+  fp2_add(acc, acc, acc);        // 4
+  fp2_add(acc, acc, acc);        // 8
+  fp2_add(acc, acc, t);          // 9
+  fp2_add(t2, acc, acc);         // 18
+  fp2_add(Y3n, t2, t2);          // 36*X^3*YYZ
+  {
+    // 27*X^6 = 16 + 8 + 2 + 1
+    Fp2 two, four, eight, sixteen;
+    fp2_add(two, x3sq, x3sq);
+    fp2_add(four, two, two);
+    fp2_add(eight, four, four);
+    fp2_add(sixteen, eight, eight);
+    fp2_add(t, sixteen, eight);
+    fp2_add(t, t, two);
+    fp2_add(t, t, x3sq);
+  }
+  fp2_sub(Y3n, Y3n, t);
+  fp2_add(t, yyzsq, yyzsq);
+  fp2_add(t2, t, t);
+  fp2_add(t, t2, t2);  // 8 (YYZ)^2
+  fp2_sub(Y3n, Y3n, t);
+  fp2_sqr(yz2, YZ);
+  fp2_mul(Z3n, yz2, YZ);  // (YZ)^3
+  fp2_add(Z3n, Z3n, Z3n);
+  fp2_add(t, Z3n, Z3n);
+  fp2_add(Z3n, t, t);  // 8 (YZ)^3
+  X = X3n;
+  Y = Y3n;
+  Z = Z3n;
+}
+
+static void ml_add_step(MLState &s, Fp12 &f) {
+  if (s.inf) return;
+  const Fp &px = s.px, &py = s.py;
+  const Fp2 &xQ = s.xQ, &yQ = s.yQ;
+  Fp2 &X = s.X, &Y = s.Y, &Z = s.Z;
+  Fp2 A, B, C, t, t2, X3n, Y3n;
+  // --- mixed addition step (Q affine): line through Q, scaled by D ---
+  Fp2 N, D, NN, DD, DDZ, xqz, yqz;
+  fp2_mul(xqz, xQ, Z);
+  fp2_mul(yqz, yQ, Z);
+  fp2_sub(N, Y, yqz);
+  fp2_sub(D, X, xqz);
+  // A = N*xQ - yQ*D ; B = -N*px ; C = D*py
+  fp2_mul(A, N, xQ);
+  fp2_mul(t, yQ, D);
+  fp2_sub(A, A, t);
+  fp_mul(B.c0, N.c0, px);
+  fp_mul(B.c1, N.c1, px);
+  fp2_neg(B, B);
+  fp_mul(C.c0, D.c0, py);
+  fp_mul(C.c1, D.c1, py);
+  fp12_mul_sparse(f, A, B, C);
+  // T = T + Q: t = N^2*Z - D^2*(X + xQ*Z);
+  //            X3 = D*t; Z3 = D^3*Z; Y3 = N*(xQ*D^2*Z - t) - yQ*D^3*Z
+  fp2_sqr(NN, N);
+  fp2_sqr(DD, D);
+  fp2_mul(DDZ, DD, Z);
+  Fp2 u_;
+  fp2_mul(u_, NN, Z);
+  fp2_mul(t2, DD, X);
+  fp2_sub(u_, u_, t2);
+  fp2_mul(t2, xQ, DDZ);
+  fp2_sub(u_, u_, t2);  // u_ = t
+  fp2_mul(X3n, D, u_);
+  Fp2 D3Z;
+  fp2_mul(D3Z, DD, D);
+  fp2_mul(D3Z, D3Z, Z);
+  fp2_mul(t, xQ, DDZ);
+  fp2_sub(t, t, u_);
+  fp2_mul(Y3n, N, t);
+  fp2_mul(t, yQ, D3Z);
+  fp2_sub(Y3n, Y3n, t);
+  X = X3n;
+  Y = Y3n;
+  Z = D3Z;
+}
+
 static void miller_loop(Fp12 &f, const G1 &p, const G2 &q) {
   // Homogeneous-projective twist coordinates: ZERO field inversions in the
   // loop (the affine variant spent ~10us/step in fp_inv). Lines are scaled
   // by per-step Fp2 factors, which the final exponentiation kills.
-  if (g1_is_inf(p) || g2_is_inf(q)) {
-    f = FP12_ONE_;
-    return;
+  MLState s;
+  ml_init(s, p, q);
+  f = FP12_ONE_;
+  if (s.inf) return;
+  int top = 63;
+  while (!((ATE_LOOP >> top) & 1)) top--;
+  for (int i = top - 1; i >= 0; i--) {
+    fp12_sqr_fast(f, f);
+    ml_dbl_step(s, f);
+    if ((ATE_LOOP >> i) & 1) ml_add_step(s, f);
   }
-  Fp px, py;
-  g1_to_affine(px, py, p);
-  Fp2 xQ, yQ;
-  g2_to_affine(xQ, yQ, q);
-  Fp2 X = xQ, Y = yQ, Z = FP2_ONE_;
+  Fp12 fc;
+  fp12_conj(fc, f);  // X_PARAM < 0
+  f = fc;
+}
+
+// Shared-squaring multi-Miller loop: ONE f^2 per iteration for the whole
+// product (the per-pair Miller loops each spent ~30% of their time in
+// fp12_sqr_fast; a 2S-pair era product shares all of them). Equal to
+// Prod_i miller_loop(p_i, q_i) because fp12_conj is a ring homomorphism.
+static void miller_loop_multi(Fp12 &f, MLState *states, size_t n) {
   f = FP12_ONE_;
   int top = 63;
   while (!((ATE_LOOP >> top) & 1)) top--;
-  Fp2 A, B, C, t, t2;
   for (int i = top - 1; i >= 0; i--) {
     fp12_sqr_fast(f, f);
-    // --- doubling step: line scaled by 2YZ^2 ---
-    Fp2 XX, YY, X3c, YZ, YYZ;
-    fp2_sqr(XX, X);
-    fp2_sqr(YY, Y);
-    fp2_mul(X3c, X, XX);  // X^3
-    fp2_mul(YZ, Y, Z);
-    fp2_mul(YYZ, YY, Z);
-    // A = 3X^3 - 2Y^2Z
-    fp2_add(t, X3c, X3c);
-    fp2_add(A, t, X3c);
-    fp2_add(t, YYZ, YYZ);
-    fp2_sub(A, A, t);
-    // B = -3*X^2*Z*px
-    Fp2 XXZ;
-    fp2_mul(XXZ, XX, Z);
-    fp2_add(t, XXZ, XXZ);
-    fp2_add(t, t, XXZ);
-    fp_mul(B.c0, t.c0, px);
-    fp_mul(B.c1, t.c1, px);
-    fp2_neg(B, B);
-    // C = 2*Y*Z^2*py
-    Fp2 YZZ;
-    fp2_mul(YZZ, YZ, Z);
-    fp2_add(t, YZZ, YZZ);
-    fp_mul(C.c0, t.c0, py);
-    fp_mul(C.c1, t.c1, py);
-    fp12_mul_sparse(f, A, B, C);
-    // T = 2T:  X3 = 2XYZ(9X^3 - 8Y^2Z); Y3 = 36X^3*YYZ - 27X^6 - 8(YYZ)^2;
-    //          Z3 = 8(YZ)^3
-    Fp2 XYZ, nine_x3, eight_yyz, X3n, Y3n, Z3n, x3sq, yyzsq, yz2;
-    fp2_mul(XYZ, X, YZ);
-    fp2_add(t, X3c, X3c);          // 2X^3
-    fp2_add(t2, t, t);             // 4X^3
-    fp2_add(t2, t2, t2);           // 8X^3
-    fp2_add(nine_x3, t2, X3c);     // 9X^3
-    fp2_add(t, YYZ, YYZ);          // 2YYZ
-    fp2_add(t2, t, t);             // 4YYZ
-    fp2_add(eight_yyz, t2, t2);    // 8YYZ
-    fp2_sub(t, nine_x3, eight_yyz);
-    fp2_mul(X3n, XYZ, t);
-    fp2_add(X3n, X3n, X3n);
-    fp2_sqr(x3sq, X3c);            // X^6
-    fp2_sqr(yyzsq, YYZ);
-    fp2_mul(t, X3c, YYZ);          // X^3*Y^2*Z
-    Fp2 acc;
-    fp2_add(acc, t, t);            // 2
-    fp2_add(acc, acc, acc);        // 4
-    fp2_add(acc, acc, acc);        // 8
-    fp2_add(acc, acc, t);          // 9
-    fp2_add(t2, acc, acc);         // 18
-    fp2_add(Y3n, t2, t2);          // 36*X^3*YYZ
-    {
-      // 27*X^6 = 16 + 8 + 2 + 1
-      Fp2 two, four, eight, sixteen;
-      fp2_add(two, x3sq, x3sq);
-      fp2_add(four, two, two);
-      fp2_add(eight, four, four);
-      fp2_add(sixteen, eight, eight);
-      fp2_add(t, sixteen, eight);
-      fp2_add(t, t, two);
-      fp2_add(t, t, x3sq);
-    }
-    fp2_sub(Y3n, Y3n, t);
-    fp2_add(t, yyzsq, yyzsq);
-    fp2_add(t2, t, t);
-    fp2_add(t, t2, t2);  // 8 (YYZ)^2
-    fp2_sub(Y3n, Y3n, t);
-    fp2_sqr(yz2, YZ);
-    fp2_mul(Z3n, yz2, YZ);  // (YZ)^3
-    fp2_add(Z3n, Z3n, Z3n);
-    fp2_add(t, Z3n, Z3n);
-    fp2_add(Z3n, t, t);  // 8 (YZ)^3
-    X = X3n;
-    Y = Y3n;
-    Z = Z3n;
-    if ((ATE_LOOP >> i) & 1) {
-      // --- mixed addition step (Q affine): line through Q, scaled by D ---
-      Fp2 N, D, NN, DD, DDZ, xqz, yqz;
-      fp2_mul(xqz, xQ, Z);
-      fp2_mul(yqz, yQ, Z);
-      fp2_sub(N, Y, yqz);
-      fp2_sub(D, X, xqz);
-      // A = N*xQ - yQ*D ; B = -N*px ; C = D*py
-      fp2_mul(A, N, xQ);
-      fp2_mul(t, yQ, D);
-      fp2_sub(A, A, t);
-      fp_mul(B.c0, N.c0, px);
-      fp_mul(B.c1, N.c1, px);
-      fp2_neg(B, B);
-      fp_mul(C.c0, D.c0, py);
-      fp_mul(C.c1, D.c1, py);
-      fp12_mul_sparse(f, A, B, C);
-      // T = T + Q: t = N^2*Z - D^2*(X + xQ*Z);
-      //            X3 = D*t; Z3 = D^3*Z; Y3 = N*(xQ*D^2*Z - t) - yQ*D^3*Z
-      fp2_sqr(NN, N);
-      fp2_sqr(DD, D);
-      fp2_mul(DDZ, DD, Z);
-      Fp2 u_;
-      fp2_mul(u_, NN, Z);
-      fp2_mul(t2, DD, X);
-      fp2_sub(u_, u_, t2);
-      fp2_mul(t2, xQ, DDZ);
-      fp2_sub(u_, u_, t2);  // u_ = t
-      fp2_mul(X3n, D, u_);
-      Fp2 D3Z;
-      fp2_mul(D3Z, DD, D);
-      fp2_mul(D3Z, D3Z, Z);
-      fp2_mul(t, xQ, DDZ);
-      fp2_sub(t, t, u_);
-      fp2_mul(Y3n, N, t);
-      fp2_mul(t, yQ, D3Z);
-      fp2_sub(Y3n, Y3n, t);
-      X = X3n;
-      Y = Y3n;
-      Z = D3Z;
+    bool add = (ATE_LOOP >> i) & 1;
+    for (size_t j = 0; j < n; j++) {
+      ml_dbl_step(states[j], f);
+      if (add) ml_add_step(states[j], f);
     }
   }
   Fp12 fc;
@@ -1784,17 +1832,60 @@ int lt_g2_msm(const uint8_t *pts, const uint8_t *scalars, size_t n,
 
 // Prod e(Pi, Qi) == 1?  returns 1 yes, 0 no, -1 bad encoding.
 int lt_pairing_check(const uint8_t *g1s, const uint8_t *g2s, size_t n) {
-  Fp12 f = FP12_ONE_;
+  std::vector<MLState> states(n);
   for (size_t i = 0; i < n; i++) {
     G1 p;
     G2 q;
     if (!g1_from_bytes(p, g1s + i * 96)) return -1;
     if (!g2_from_bytes(q, g2s + i * 192)) return -1;
-    Fp12 m;
-    miller_loop(m, p, q);
-    Fp12 t;
-    fp12_mul(t, f, m);
-    f = t;
+    ml_init(states[i], p, q);
+  }
+  Fp12 f;
+  miller_loop_multi(f, states.data(), n);
+  Fp12 e;
+  final_exponentiation(e, f);
+  return fp12_is_one(e) ? 1 : 0;
+}
+
+// Threaded variant for the era-sized grand product (2S pairs at N=64):
+// Miller loops are independent, so partition them across threads, multiply
+// the partial Fp12 products, and run ONE shared final exponentiation.
+// nthreads <= 1 falls back to the serial loop above.
+int lt_pairing_check_mt(const uint8_t *g1s, const uint8_t *g2s, size_t n,
+                        int nthreads) {
+  if (nthreads <= 1 || n < 8) return lt_pairing_check(g1s, g2s, n);
+  if ((size_t)nthreads > n / 2) nthreads = (int)(n / 2);
+  std::vector<Fp12> partial(nthreads, FP12_ONE_);
+  std::vector<int> bad(nthreads, 0);
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    size_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    ts.emplace_back([&, t, lo, hi]() {
+      std::vector<MLState> states(hi - lo);
+      for (size_t i = lo; i < hi; i++) {
+        G1 p;
+        G2 q;
+        if (!g1_from_bytes(p, g1s + i * 96) ||
+            !g2_from_bytes(q, g2s + i * 192)) {
+          bad[t] = 1;
+          return;
+        }
+        ml_init(states[i - lo], p, q);
+      }
+      Fp12 f;
+      miller_loop_multi(f, states.data(), hi - lo);
+      partial[t] = f;
+    });
+  }
+  for (auto &th : ts) th.join();
+  for (int t = 0; t < nthreads; t++)
+    if (bad[t]) return -1;
+  Fp12 f = FP12_ONE_;
+  for (int t = 0; t < nthreads; t++) {
+    Fp12 tmp;
+    fp12_mul(tmp, f, partial[t]);
+    f = tmp;
   }
   Fp12 e;
   final_exponentiation(e, f);

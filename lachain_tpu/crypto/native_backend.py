@@ -107,11 +107,19 @@ class NativeBackend:
 
     # -- pairings ------------------------------------------------------------
     def pairing_check(self, pairs: Sequence[Tuple[tuple, tuple]]) -> bool:
+        """Prod e(P_i, Q_i) == 1. Large products (the era-sized grand check,
+        2S pairs) spread their independent Miller loops across threads with
+        one shared final exponentiation; small ones stay serial (thread
+        spawn would dominate)."""
         if not pairs:
             return True
         g1s = b"".join(bls.g1_to_bytes(p) for p, _ in pairs)
         g2s = b"".join(bls.g2_to_bytes(q) for _, q in pairs)
-        rc = self._lib.lt_pairing_check(g1s, g2s, len(pairs))
+        if len(pairs) >= 8:
+            nt = min(os.cpu_count() or 1, 16)
+            rc = self._lib.lt_pairing_check_mt(g1s, g2s, len(pairs), nt)
+        else:
+            rc = self._lib.lt_pairing_check(g1s, g2s, len(pairs))
         if rc < 0:
             raise ValueError("native pairing_check: bad encoding")
         return rc == 1

@@ -188,6 +188,108 @@ class GlvEraPipeline:
         return out, rlc
 
 
+class PallasEraPipeline:
+    """Round-3 era pipeline on the VMEM-resident Pallas kernel (ops/pg1.py).
+
+    Same contract as GlvEraPipeline.run_era, ~12x faster on the chip: the
+    windowed MSM runs as one pallas_call per pass with the accumulator and
+    the 16-entry tables resident in VMEM, the marshal uploads raw Jacobian
+    limbs (no batch inversion, no Montgomery scale), and all per-era device
+    outputs come back in a single buffer (the tunnel charges fixed latency
+    per distinct buffer).
+
+    Reference semantics unchanged: TPKE/PublicKey.cs:55-92 via
+    HoneyBadger.cs:205-247."""
+
+    def __init__(self, backend=None):
+        from ..crypto.provider import get_backend
+
+        self._backend = backend or get_backend()
+        self._y_cache = {}
+
+    def y_device(self, y_points, s: int):
+        """Pack + upload the verification keys once per validator set and
+        cache the (132, S*K_pad) duplicated lane block on device (same
+        strong-reference identity scheme as GlvEraPipeline.y_device).
+        K pads to the next power of two to match run_era's lane layout."""
+        import jax.numpy as jnp
+
+        from . import pg1
+
+        key = (id(y_points), s)
+        hit = self._y_cache.get(key)
+        if hit is not None and hit[0] is y_points:
+            return hit[1]
+        k = len(y_points)
+        k_pad = 1 << max(0, k - 1).bit_length() if k > 1 else 1
+        padded = list(y_points) + [bls.G1_INF] * (k_pad - k)
+        y_one = pg1.g1_pack(padded)  # (132, K_pad)
+        y_dev = jnp.asarray(np.tile(y_one, (1, s)))  # (132, S*K_pad)
+        if len(self._y_cache) >= 4:
+            self._y_cache.pop(next(iter(self._y_cache)))
+        self._y_cache[key] = (y_points, y_dev)
+        return y_dev
+
+    def run_era(self, slots, y_points, rng):
+        """slots: list of (u_list, lagrange_list) per ACS slot; y_points:
+        the K verification keys. Returns (per-slot (u_agg, y_agg, combined)
+        oracle points, rlc coefficients used)."""
+        import jax.numpy as jnp
+
+        from . import pg1
+        from .msm import glv_split
+
+        s = len(slots)
+        k = len(y_points)
+        for u_list, lag_list in slots:
+            if len(u_list) != k or len(lag_list) != k:
+                raise ValueError(
+                    f"every slot must carry exactly {k} shares/coefficients"
+                )
+        # the in-kernel tree reduce sums power-of-two groups of adjacent
+        # lanes: pad each slot to the next power of two with flagged-out
+        # filler lanes (zero digits -> infinity flags)
+        k_pad = 1 << max(0, k - 1).bit_length() if k > 1 else 1
+        pad = k_pad - k
+        u_flat = [u for u_list, _ in slots for u in u_list + [bls.G1_INF] * pad]
+        u_np = pg1.g1_pack(u_flat)
+        y_dev = self.y_device(y_points, s)
+        rlc = [
+            [rng.randbelow((1 << 64) - 1) + 1 for _ in range(k)]
+            for _ in range(s)
+        ]
+        rlc_flat = [c for row in rlc for c in row + [0] * pad]
+        lag_flat = [
+            c for _, lag_list in slots for c in lag_list + [0] * pad
+        ]
+        halves = [glv_split(v) for v in lag_flat]
+        rlc16 = pg1.digits_col(rlc_flat, pg1.W64)
+        lag1 = pg1.digits_col([h[0] for h in halves], pg1.W128)
+        lag2 = pg1.digits_col([h[1] for h in halves], pg1.W128)
+        buf = jnp.asarray(pg1.era_pack_inputs(u_np, rlc16, lag1, lag2))
+        fused = pg1.era_kernel_packed_jit(buf, y_dev, k_pad, s * k_pad)
+        fused = np.asarray(fused)  # ONE device->host transfer
+        pts, flags = fused[:132], fused[132] != 0
+        cols = pg1.g1_unpack(pts, flags)  # 4S points: u_agg|y_agg|c1|c2
+        out = []
+        for i in range(s):
+            u_agg = cols[i]
+            y_agg = cols[s + i]
+            comb = bls.g1_add(cols[2 * s + i], cols[3 * s + i])
+            if comb[2] == 0 and any(c for c in slots[i][1]):
+                # incomplete-add collision in the combine tree: no random-
+                # coefficient soundness on this lane group, so fall back to
+                # the host oracle MSM for the slot (same escape hatch as
+                # GlvEraPipeline.run_era)
+                u_list, lag_list = slots[i]
+                comb = self._backend.g1_msm(
+                    [u for u, c in zip(u_list, lag_list) if c],
+                    [c for c in lag_list if c],
+                )
+            out.append((u_agg, y_agg, comb))
+        return out, rlc
+
+
 class TpuTpkeVerifier:
     """Host-side wrapper: marshals oracle-format shares to the device kernel
     and finishes with 2 native pairings.

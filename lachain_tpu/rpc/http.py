@@ -87,7 +87,10 @@ class JsonRpcServer:
                     h = await read(reader.readline())
                     if h in (b"\r\n", b"\n", b""):
                         break
-                    k, _, v = h.decode().partition(":")
+                    try:
+                        k, _, v = h.decode().partition(":")
+                    except UnicodeDecodeError:
+                        return
                     headers[k.strip().lower()] = v.strip()
                 else:
                     return  # header flood

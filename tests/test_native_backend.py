@@ -108,3 +108,43 @@ def test_serial_verify_shares(nb):
     uis[2] = bls.g1_mul(uis[2], 2)
     oks = nb.tpke_verify_shares_serial(uis, yis, h, w)
     assert oks == [True, True, False, True]
+
+
+def test_threaded_pairing_check_matches_serial():
+    """lt_pairing_check_mt partitions Miller loops across threads; on this
+    box cpu_count may be 1 (auto path stays serial), so drive the threaded
+    entry point directly and compare against the serial one — valid and
+    tampered products, plus an n not divisible by nthreads."""
+    import random
+
+    from lachain_tpu.crypto import bls12381 as bls
+    from lachain_tpu.crypto.native_backend import NativeBackend
+
+    rng = random.Random(99)
+    b = NativeBackend()
+    pairs = []
+    for _ in range(5):
+        x, y = rng.randrange(1, bls.R), rng.randrange(1, bls.R)
+        p = bls.g1_mul(bls.G1_GEN, x)
+        q = bls.g2_mul(bls.G2_GEN, y)
+        pn = bls.g1_neg(bls.g1_mul(bls.G1_GEN, x * y % bls.R))
+        pairs += [(p, q), (pn, bls.G2_GEN)]  # e(P,Q)e(-xyG1,G2) = 1
+
+    def check_mt(ps, nthreads):
+        g1s = b"".join(bls.g1_to_bytes(p) for p, _ in ps)
+        g2s = b"".join(bls.g2_to_bytes(q) for _, q in ps)
+        rc = b._lib.lt_pairing_check_mt(g1s, g2s, len(ps), nthreads)
+        assert rc >= 0
+        return rc == 1
+
+    for nt in (2, 3, 4):
+        assert check_mt(pairs, nt) is True
+    bad = list(pairs)
+    bad[3] = (bls.g1_mul(bls.G1_GEN, 12345), bad[3][1])
+    for nt in (2, 3, 4):
+        assert check_mt(bad, nt) is False
+    # bad encoding in a middle thread's slice must report -1 -> ValueError
+    g1s = bytearray(b"".join(bls.g1_to_bytes(p) for p, _ in pairs))
+    g1s[5 * 96 : 6 * 96] = b"\xff" * 96
+    g2s = b"".join(bls.g2_to_bytes(q) for _, q in pairs)
+    assert b._lib.lt_pairing_check_mt(bytes(g1s), g2s, len(pairs), 3) == -1
