@@ -82,9 +82,12 @@ class HoneyBadger(Protocol):
             self._shares.setdefault(slot, {})[self.me] = dec
         stashed, self._stashed = self._stashed, {}
         for (sender, _slot), msg in stashed.items():
-            self._on_decrypted(sender, msg)
-        for slot in list(self._ciphertexts):
-            self._try_decrypt(slot)
+            self._on_decrypted(sender, msg, defer_decrypt=True)
+        # era-tick aggregation point: by the time ACS completes, most slots
+        # already hold their F+1 shares (they arrived during agreement and
+        # were stashed) — decrypt them all in ONE batched call. This is the
+        # S x K kernel shape BASELINE.md measures.
+        self._try_decrypt_ready()
         self._try_complete()
 
     # -- externals -----------------------------------------------------------
@@ -98,7 +101,9 @@ class HoneyBadger(Protocol):
             return
         self._on_decrypted(sender, payload)
 
-    def _on_decrypted(self, sender: int, msg: M.DecryptedMessage) -> None:
+    def _on_decrypted(
+        self, sender: int, msg: M.DecryptedMessage, defer_decrypt: bool = False
+    ) -> None:
         slot = msg.share_id
         if slot not in (self._ciphertexts or {}):
             return  # unknown/rejected slot
@@ -116,10 +121,86 @@ class HoneyBadger(Protocol):
         if sender in slot_shares or sender in self._rejected.get(slot, set()):
             return
         slot_shares[sender] = dec
-        self._try_decrypt(slot)
-        self._try_complete()
+        if not defer_decrypt:
+            self._try_decrypt_ready()
+            self._try_complete()
 
     # -- batched verify + combine --------------------------------------------
+    def _ready_slots(self) -> List[int]:
+        need = self._pub.f + 1
+        return [
+            s
+            for s in (self._ciphertexts or {})
+            if s not in self._plaintexts
+            and len(self._shares.get(s, {})) >= need
+        ]
+
+    def _try_decrypt_ready(self) -> None:
+        """Decrypt every slot holding >= F+1 candidate shares, batching all
+        of them through the TPU backend's era kernel when it is active
+        (opportunistic micro-batching: whatever is pending runs NOW; with
+        the host backends this degrades to the per-slot RLC batch path).
+        """
+        ready = self._ready_slots()
+        if not ready:
+            return
+        from ..crypto.provider import get_backend
+
+        backend = get_backend()
+        era_fn = getattr(backend, "tpke_era_verify_combine", None)
+        if era_fn is None or self._skip_validation:
+            for slot in ready:
+                self._try_decrypt(slot)
+            return
+        from ..crypto import bls12381 as bls
+        from ..crypto.tpu_backend import EraSlotJob
+
+        need = self._pub.f + 1
+        jobs = []
+        for slot in ready:
+            ct = self._ciphertexts[slot]
+            slot_shares = self._shares[slot]
+            chosen = sorted(slot_shares)[:need]
+            cs = bls.fr_lagrange_coeffs([i + 1 for i in chosen], at=0)
+            lag_row = [0] * self.n
+            u_row = [None] * self.n
+            # only the chosen F+1 lanes go live: they are exactly the
+            # shares the combine consumes, so a byzantine validator's
+            # extra bad share (never combined) cannot fail the grand check
+            # and force the host fallback every era
+            for i, c in zip(chosen, cs):
+                lag_row[i] = c
+                u_row[i] = slot_shares[i].ui
+            jobs.append(
+                EraSlotJob(
+                    u_by_validator=u_row,
+                    lagrange_row=lag_row,
+                    h=tpke.ciphertext_h(ct),
+                    w=ct.w,
+                )
+            )
+        try:
+            results = era_fn(jobs, self._pub.tpke_verification_keys)
+        except Exception:
+            # device path unavailable/broken (jax import, compile, OOM):
+            # consensus liveness beats acceleration — host per-slot path
+            from .protocol import logger as _plog
+
+            _plog.exception("tpu era decrypt failed; host fallback")
+            for slot in ready:
+                self._try_decrypt(slot)
+            return
+        for slot, (ok, combined) in zip(ready, results):
+            if ok:
+                self._plaintexts[slot] = tpke.decrypt_with_combined(
+                    self._ciphertexts[slot], combined
+                )
+            else:
+                # a byzantine share poisoned the slot batch: the host path
+                # isolates + prunes it (and may still decrypt from the
+                # surviving valid shares)
+                self._try_decrypt(slot)
+
     def _try_decrypt(self, slot: int) -> None:
         if slot in self._plaintexts or self._ciphertexts is None:
             return

@@ -5,11 +5,12 @@ Parity with the reference's provider seam (`ICrypto` / `CryptoProvider`,
 all threshold-crypto consumers go through a small backend interface so the
 implementation can be swapped without touching consensus code.
 
-Three backends exist (or will):
+Three backends exist:
   * ``python``  — the pure-Python oracle (lachain_tpu.crypto.bls12381).
   * ``native``  — C++ libbls381 via ctypes (fast host path; MCL equivalent).
-  * ``tpu``     — JAX batched kernels for the MSM-heavy batch ops
-                  (lachain_tpu.ops); pairings delegate to native/python.
+  * ``tpu``     — Pallas era kernels for the MSM-heavy batch ops
+                  (crypto/tpu_backend.py over ops/pg1.py); pairings,
+                  hashing and scalar ops delegate to native/python.
 
 The batch operations are the TPU-first redesign: where the reference verifies
 each decryption share with 2 pairings (TPKE/PublicKey.cs:88-92, executed
@@ -136,6 +137,11 @@ def get_backend():
     if _BACKEND is not None:
         return _BACKEND
     choice = os.environ.get("LACHAIN_TPU_BACKEND", "auto")
+    if choice == "tpu":
+        from .tpu_backend import TpuBackend
+
+        _BACKEND = TpuBackend()
+        return _BACKEND
     if choice in ("native", "auto"):
         try:
             from .native_backend import NativeBackend

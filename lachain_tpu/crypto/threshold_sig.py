@@ -208,6 +208,84 @@ class TsPublicKeySet:
         return Signature(sigma)
 
 
+def era_verify_combine(
+    key_set: TsPublicKeySet,
+    coins,
+    rng=secrets,
+):
+    """Era-tick batch: verify + combine MANY coins' shares at once.
+
+    coins: list of (msg: bytes, shares: Dict[int, PartialSignature]) — one
+    entry per pending coin, shares keyed by signer id (>= t+1 each).
+    Returns a list of Optional[Signature] (None where a coin's batch
+    contained an invalid share — callers fall back to the per-share path
+    to prune it, mirroring ThresholdSigner.add_share).
+
+    With the `tpu` backend this rides the Pallas G2 era kernel
+    (ops/pg2.py) behind `ts_era_verify_combine` — S x K lanes, one grand
+    multi-pairing; elsewhere it degrades to the same per-coin host ops
+    TsPublicKeySet.batch_verify_shares/combine use. Reference semantics:
+    ThresholdSigner.cs:45-95 + PublicKeySet.cs:35-44, serial there.
+    """
+    # both paths verify exactly the chosen (lowest-signer-id) t+1 shares —
+    # the ones the combine consumes — so the device and host backends agree
+    # on every input (an unchosen invalid share can never flip the result);
+    # coins without t+1 in-range signers resolve to None without any work
+    out: List[Optional[Signature]] = [None] * len(coins)
+    live: List[int] = []
+    chosen_per_coin: List[list] = []
+    for idx, (_msg, shares) in enumerate(coins):
+        valid_ids = sorted(i for i in shares if 0 <= i < key_set.n)
+        if len(valid_ids) > key_set.t:
+            live.append(idx)
+            chosen_per_coin.append(valid_ids[: key_set.t + 1])
+
+    def host_path():
+        for idx, signers in zip(live, chosen_per_coin):
+            msg, shares = coins[idx]
+            chosen = [shares[i] for i in signers]
+            oks = key_set.batch_verify_shares(msg, chosen, rng=rng)
+            out[idx] = key_set.combine(chosen) if all(oks) else None
+        return out
+
+    backend = get_backend()
+    era_fn = getattr(backend, "ts_era_verify_combine", None)
+    if era_fn is None or not live:
+        return host_path()
+    from .tpu_backend import CoinJob
+
+    jobs = []
+    for idx, signers in zip(live, chosen_per_coin):
+        msg, shares = coins[idx]
+        cs = bls.fr_lagrange_coeffs([i + 1 for i in signers], at=0)
+        lag_row = [0] * key_set.n
+        sigma_row = [None] * key_set.n
+        for i, c in zip(signers, cs):
+            lag_row[i] = c
+            sigma_row[i] = shares[i].sigma
+        jobs.append(
+            CoinJob(
+                sigma_by_signer=sigma_row,
+                lagrange_row=lag_row,
+                h=_hash_to_sig_point(msg),
+            )
+        )
+    try:
+        results = era_fn(jobs, key_set.keys, rng=rng)
+    except Exception:
+        # device path unavailable/broken: liveness beats acceleration —
+        # same degradation rule as HoneyBadger._try_decrypt_ready
+        import logging
+
+        logging.getLogger("lachain.crypto").exception(
+            "tpu coin era path failed; host fallback"
+        )
+        return host_path()
+    for idx, (ok, comb) in zip(live, results):
+        out[idx] = Signature(comb) if ok else None
+    return out
+
+
 class TsPrivateKeyShare:
     """Validator signing share x_i
     (reference: ThresholdSignature/PrivateKeyShare.cs)."""

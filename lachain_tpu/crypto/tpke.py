@@ -53,6 +53,19 @@ def _hash_uv_to_g2(u: tuple, v: bytes) -> tuple:
     )
 
 
+def ciphertext_h(share: "EncryptedShare") -> tuple:
+    """H_G2(U, V) for a ciphertext — the G2 point every share of this
+    ciphertext is verified against (e(U_i, H) == e(Y_i, W))."""
+    return _hash_uv_to_g2(share.u, share.v)
+
+
+def decrypt_with_combined(share: "EncryptedShare", y_r: tuple) -> bytes:
+    """Strip the pad given the combined point U^x (the tail of
+    full_decrypt, exposed for callers that obtained `y_r` from the batched
+    era kernel instead of a host Lagrange loop)."""
+    return bytes(a ^ b for a, b in zip(share.v, _pad(y_r, len(share.v))))
+
+
 @dataclass(frozen=True)
 class EncryptedShare:
     """Ciphertext of one validator's tx-batch share
@@ -227,9 +240,7 @@ class TpkePublicKey:
         xs = [d.decryptor_id + 1 for d in decs]
         cs = bls.fr_lagrange_coeffs(xs, at=0)
         y_r = get_backend().g1_msm([d.ui for d in decs], cs)
-        return bytes(
-            a ^ b for a, b in zip(share.v, _pad(y_r, len(share.v)))
-        )
+        return decrypt_with_combined(share, y_r)
 
 
 @dataclass(frozen=True)

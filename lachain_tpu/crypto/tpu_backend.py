@@ -1,0 +1,288 @@
+"""TPU crypto backend: the device data plane behind the provider seam.
+
+This is the third backend promised by `lachain_tpu.crypto.provider`
+(role of the MCL-native provider swap in the reference,
+/root/reference/src/Lachain.Crypto/CryptoProvider.cs:3-11 + ICrypto.cs:5-117):
+consensus code calls the same interface, and the MSM-heavy batch work —
+TPKE decryption-share verification + Lagrange combination, the era hot path
+(HoneyBadger.cs:205-247 via TPKE/PublicKey.cs:55-92) — runs on the chip
+through the Pallas era kernel (ops/pg1.py), while scalar ops, hashing and
+pairings delegate to the host backend (native C++ if built, else the
+Python oracle).
+
+Design notes (SURVEY.md §7 hard part #4 — host<->TPU latency):
+  * Opportunistic micro-batching: `tpke_era_verify_combine` runs whatever
+    slots are ready RIGHT NOW (S >= 1); it never waits to fill a batch.
+  * The Pallas kernel has static shapes: the slot count pads to the next
+    power of two with fully-masked dummy slots, so at most log2(N)+1
+    distinct (S_pad, K_pad) shapes ever compile per validator-set size.
+  * Soundness: per-lane 64-bit random-linear-combination coefficients make
+    every slot's aggregate equality independently random; all live slots
+    fold into ONE grand multi-pairing (2 pairs per slot, shared final
+    exponentiation). On failure the slot set is bisected — O(log S) pairing
+    checks per bad slot, no extra kernel launches — and bad slots are
+    reported invalid so callers fall back to the per-share host path.
+"""
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from . import bls12381 as bls
+from ..utils import metrics
+
+
+@dataclass
+class CoinJob:
+    """One common coin's pending share verification+combination work.
+
+    sigma_by_signer: length-K row of partial-signature points (G2); None
+        where validator j's share has not arrived (lane masked out).
+    lagrange_row:    length-K row of Lagrange-at-0 coefficients; nonzero
+        exactly on the t+1 shares chosen for the combination.
+    h:               H_G2(msg) — the hashed coin id being signed.
+    """
+
+    sigma_by_signer: List[Optional[tuple]]
+    lagrange_row: List[int]
+    h: tuple
+
+
+@dataclass
+class EraSlotJob:
+    """One ACS slot's pending verification+combination work.
+
+    u_by_validator: length-K row of decryption-share points; None where
+        validator j's share has not arrived (that lane is masked out).
+    lagrange_row:   length-K row of Lagrange-at-0 coefficients; nonzero
+        exactly on the t+1 shares chosen for the combination.
+    h:              H_G2(U, V) for the slot's ciphertext.
+    w:              the ciphertext's W point (G2).
+    """
+
+    u_by_validator: List[Optional[tuple]]
+    lagrange_row: List[int]
+    h: tuple
+    w: tuple
+
+
+class TpuBackend:
+    """Provider backend routing era-shaped batch crypto through the TPU.
+
+    Everything not explicitly overridden delegates to the host backend
+    (`native` C++ when available, else the Python oracle) — pairings,
+    hash-to-curve, deserialization, and single scalar muls are host ops by
+    design (BASELINE.md: the host<->device split is the "sidecar" seam).
+    """
+
+    name = "tpu"
+
+    def __init__(self, host_backend=None, pipeline=None, ts_pipeline=None):
+        if host_backend is None:
+            try:
+                from .native_backend import NativeBackend
+
+                host_backend = NativeBackend()
+            except Exception:
+                from .provider import PythonBackend
+
+                host_backend = PythonBackend()
+        self._host = host_backend
+        self._pipeline = pipeline  # lazy PallasEraPipeline (G1/TPKE)
+        self._ts_pipeline = ts_pipeline  # lazy TsPallasPipeline (G2/coins)
+        self._y_cache: dict = {}
+        # observability: proves the device path executed (asserted by tests
+        # and exported through /metrics)
+        self.era_calls = 0
+        self.era_slots_total = 0
+        self.ts_era_calls = 0
+        self.ts_era_coins_total = 0
+
+    def __getattr__(self, item):
+        # only consulted for attributes NOT defined on TpuBackend: pairings,
+        # hashing, g1/g2 ops, deserialization all ride the host backend
+        return getattr(self._host, item)
+
+    # -- device pipeline -----------------------------------------------------
+    def _get_pipeline(self):
+        if self._pipeline is None:
+            import os
+
+            import jax
+
+            from ..ops.verify import HostEraPipeline, PallasEraPipeline
+
+            # Pallas on a real chip; host-MSM emulation of the same contract
+            # elsewhere (XLA-CPU compilation of the interpret-mode kernel
+            # costs ~390 s per static shape — unusable for CI or CPU-only
+            # deployments). LTPU_FORCE_PALLAS=1 overrides for kernel debug.
+            if (
+                jax.default_backend() == "tpu"
+                or os.environ.get("LTPU_FORCE_PALLAS") == "1"
+            ):
+                self._pipeline = PallasEraPipeline(self._host)
+            else:
+                self._pipeline = HostEraPipeline(self._host)
+        return self._pipeline
+
+    def _get_ts_pipeline(self):
+        if self._ts_pipeline is None:
+            import os
+
+            import jax
+
+            from ..ops.verify import TsHostEraPipeline, TsPallasPipeline
+
+            if (
+                jax.default_backend() == "tpu"
+                or os.environ.get("LTPU_FORCE_PALLAS") == "1"
+            ):
+                self._ts_pipeline = TsPallasPipeline(self._host)
+            else:
+                self._ts_pipeline = TsHostEraPipeline(self._host)
+        return self._ts_pipeline
+
+    def _stable_y_points(self, vks, attr: str = "y_i") -> list:
+        """One stable y-point list per verification-key list so the
+        pipeline's device-side key marshal caches across eras (keyed by
+        identity with a strong reference, same scheme as the pipeline).
+        attr: "y_i" for TPKE verification keys, "y" for TS public keys."""
+        key = (id(vks), attr)
+        hit = self._y_cache.get(key)
+        if hit is not None and hit[0] is vks:
+            return hit[1]
+        y_points = [getattr(vk, attr) for vk in vks]
+        if len(self._y_cache) >= 8:
+            self._y_cache.pop(next(iter(self._y_cache)))
+        self._y_cache[key] = (vks, y_points)
+        return y_points
+
+    # -- the era-tick batch op ----------------------------------------------
+    @metrics.timed("crypto_tpu_era_verify_combine")
+    def tpke_era_verify_combine(
+        self,
+        jobs: Sequence[EraSlotJob],
+        verification_keys,
+        rng=secrets,
+    ) -> List[Tuple[bool, Optional[tuple]]]:
+        """Verify + combine every pending slot in ONE kernel launch.
+
+        Returns per-job (all_shares_valid, combined_point). When a job's
+        shares all verify, `combined` is U^x for the slot (feed the XOF pad
+        directly — no separate full_decrypt needed). When the grand pairing
+        check fails, bisection isolates the offending slot(s); those report
+        (False, None) and the caller falls back to per-share host
+        verification to prune the bad share(s).
+
+        Reference semantics being batched: TPKE/PublicKey.cs:88-92 (per-
+        share verify) + :55-86 (per-slot Lagrange combine), executed there
+        serially per message via HoneyBadger.cs:205-247.
+        """
+        if not jobs:
+            return []
+        results = self._run_era_batch(
+            jobs=jobs,
+            rows=[j.u_by_validator for j in jobs],
+            lags=[j.lagrange_row for j in jobs],
+            y_points=self._stable_y_points(verification_keys),
+            inf_point=bls.G1_INF,
+            pipeline_getter=self._get_pipeline,
+            pairs_for=lambda job, agg: [
+                (agg[0], job.h),
+                (bls.g1_neg(agg[1]), job.w),
+            ],
+            rng=rng,
+        )
+        self.era_calls += 1
+        self.era_slots_total += len(jobs)
+        metrics.inc("crypto_tpu_era_kernel_calls")
+        return results
+
+    def _run_era_batch(
+        self, jobs, rows, lags, y_points, inf_point, pipeline_getter,
+        pairs_for, rng,
+    ) -> List[Tuple[bool, Optional[tuple]]]:
+        """Shared engine for both era ops: mask absent lanes, pad the slot
+        axis to a power of two with fully-masked dummy slots (bounds the
+        static kernel shapes to log2(N)+1 per K), run the pipeline, then
+        grand-multi-pair + bisect. `pairs_for(job, agg)` yields the two
+        pairing pairs encoding that slot's verification equality; each
+        slot's equality is independently randomized by its own RLC
+        coefficients, so a pairing product over any subset is a sound
+        batch check for that subset."""
+        from ..ops.verify import _pow2_at_least
+
+        s = len(jobs)
+        if s == 0:
+            return []
+        k = len(y_points)
+        for row, lag in zip(rows, lags):
+            if len(row) != k or len(lag) != k:
+                raise ValueError(f"era job rows must have length {k}")
+        slots = []
+        masks = []
+        for row, lag in zip(rows, lags):
+            masks.append([p is not None for p in row])
+            slots.append(
+                ([p if p is not None else inf_point for p in row], list(lag))
+            )
+        for _ in range(_pow2_at_least(s) - s):
+            slots.append(([inf_point] * k, [0] * k))
+            masks.append([False] * k)
+        aggs, _rlc = pipeline_getter().run_era(
+            slots, y_points, rng, masks=masks
+        )
+
+        def group_ok(idx: List[int]) -> bool:
+            pairs = []
+            for i in idx:
+                pairs.extend(pairs_for(jobs[i], aggs[i]))
+            return self._host.pairing_check(pairs)
+
+        from .provider import batch_bisect_verify
+
+        ok_flags = batch_bisect_verify(group_ok, s)
+        return [
+            (ok, aggs[i][2] if ok else None)
+            for i, ok in enumerate(ok_flags)
+        ]
+
+    @metrics.timed("crypto_tpu_ts_era_verify_combine")
+    def ts_era_verify_combine(
+        self,
+        jobs: Sequence[CoinJob],
+        ts_public_keys,
+        rng=secrets,
+    ) -> List[Tuple[bool, Optional[tuple]]]:
+        """Verify + combine every pending common coin in ONE kernel launch.
+
+        `ts_public_keys` is the per-validator TS key list (TsPublicKey,
+        G1). Returns per-coin (all_shares_valid, combined_sigma). Same
+        grand-multi-pairing + slot-bisection structure as
+        `tpke_era_verify_combine`; the verify equality per coin is
+        e(g1, sum c sigma_j) == e(sum c Y_j, H(coin id)).
+
+        Reference semantics being batched: ThresholdSigner.cs:45-95 (2
+        pairings per share) + PublicKeySet.cs:35-44 (serial G2 Lagrange),
+        via CommonCoin.cs:75-96.
+        """
+        if not jobs:
+            return []
+        results = self._run_era_batch(
+            jobs=jobs,
+            rows=[j.sigma_by_signer for j in jobs],
+            lags=[j.lagrange_row for j in jobs],
+            y_points=self._stable_y_points(ts_public_keys, attr="y"),
+            inf_point=bls.G2_INF,
+            pipeline_getter=self._get_ts_pipeline,
+            pairs_for=lambda job, agg: [
+                (bls.G1_GEN, agg[0]),
+                (bls.g1_neg(agg[1]), job.h),
+            ],
+            rng=rng,
+        )
+        self.ts_era_calls += 1
+        self.ts_era_coins_total += len(jobs)
+        metrics.inc("crypto_tpu_ts_era_kernel_calls")
+        return results

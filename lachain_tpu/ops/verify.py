@@ -83,6 +83,64 @@ def tpke_era_slots_step(u_pts, y_pts, rlc_bits, lagrange_bits):
 tpke_era_slots_step_jit = jax.jit(tpke_era_slots_step)
 
 
+def era_rlc(slots, k: int, rng, masks=None):
+    """Shared S x K validation + RLC-coefficient generation for every era
+    pipeline (device and host): per-lane 64-bit coefficients, zeroed on
+    masked (absent-share) lanes. One definition so coefficient width and
+    mask semantics cannot diverge between pipelines."""
+    s = len(slots)
+    for a_list, b_list in slots:
+        if len(a_list) != k or len(b_list) != k:
+            raise ValueError(
+                f"every slot must carry exactly {k} shares/coefficients"
+            )
+    if masks is not None and (
+        len(masks) != s or any(len(m) != k for m in masks)
+    ):
+        raise ValueError("masks must be S x K")
+    rlc = [
+        [rng.randbelow((1 << 64) - 1) + 1 for _ in range(k)]
+        for _ in range(s)
+    ]
+    if masks is not None:
+        rlc = [
+            [c if m else 0 for c, m in zip(row, mrow)]
+            for row, mrow in zip(rlc, masks)
+        ]
+    return rlc
+
+
+class _TiledYCache:
+    """Device-side marshal cache for era-invariant verification keys: one
+    (rows, S*K_pad) tiled lane block per (key list, S, K_pad), keyed by
+    id() with a strong reference so a collected list can never alias a new
+    validator set (shared by the G1 and G2 Pallas pipelines)."""
+
+    def __init__(self, limit: int = 4):
+        self._cache = {}
+        self._limit = limit
+
+    def get(self, y_points, s: int, k_pad: int):
+        import jax.numpy as jnp
+
+        from . import pg1
+
+        key = (id(y_points), s, k_pad)
+        hit = self._cache.get(key)
+        if hit is not None and hit[0] is y_points:
+            return hit[1]
+        padded = list(y_points) + [bls.G1_INF] * (k_pad - len(y_points))
+        y_dev = jnp.asarray(np.tile(pg1.g1_pack(padded), (1, s)))
+        if len(self._cache) >= self._limit:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = (y_points, y_dev)
+        return y_dev
+
+
+def _pow2_at_least(k: int) -> int:
+    return 1 << max(0, k - 1).bit_length() if k > 1 else 1
+
+
 class GlvEraPipeline:
     """Round-2 era pipeline on the GLV/windowed kernel (ops/msm.py).
 
@@ -205,35 +263,27 @@ class PallasEraPipeline:
         from ..crypto.provider import get_backend
 
         self._backend = backend or get_backend()
-        self._y_cache = {}
+        self._y_cache = _TiledYCache()
 
     def y_device(self, y_points, s: int):
         """Pack + upload the verification keys once per validator set and
-        cache the (132, S*K_pad) duplicated lane block on device (same
-        strong-reference identity scheme as GlvEraPipeline.y_device).
-        K pads to the next power of two to match run_era's lane layout."""
-        import jax.numpy as jnp
+        cache the (132, S*K_pad) duplicated lane block on device
+        (_TiledYCache). K pads to the next power of two to match
+        run_era's lane layout."""
+        return self._y_cache.get(
+            y_points, s, _pow2_at_least(len(y_points))
+        )
 
-        from . import pg1
-
-        key = (id(y_points), s)
-        hit = self._y_cache.get(key)
-        if hit is not None and hit[0] is y_points:
-            return hit[1]
-        k = len(y_points)
-        k_pad = 1 << max(0, k - 1).bit_length() if k > 1 else 1
-        padded = list(y_points) + [bls.G1_INF] * (k_pad - k)
-        y_one = pg1.g1_pack(padded)  # (132, K_pad)
-        y_dev = jnp.asarray(np.tile(y_one, (1, s)))  # (132, S*K_pad)
-        if len(self._y_cache) >= 4:
-            self._y_cache.pop(next(iter(self._y_cache)))
-        self._y_cache[key] = (y_points, y_dev)
-        return y_dev
-
-    def run_era(self, slots, y_points, rng):
+    def run_era(self, slots, y_points, rng, masks=None):
         """slots: list of (u_list, lagrange_list) per ACS slot; y_points:
         the K verification keys. Returns (per-slot (u_agg, y_agg, combined)
-        oracle points, rlc coefficients used)."""
+        oracle points, rlc coefficients used).
+
+        masks (optional): per-slot list of K bools; False lanes get a ZERO
+        RLC coefficient so absent shares (the live-node case, where a slot
+        holds only the F+1..K shares that have arrived) contribute to
+        neither aggregate — the u_list entry for such a lane is ignored
+        (pass G1_INF)."""
         import jax.numpy as jnp
 
         from . import pg1
@@ -241,23 +291,15 @@ class PallasEraPipeline:
 
         s = len(slots)
         k = len(y_points)
-        for u_list, lag_list in slots:
-            if len(u_list) != k or len(lag_list) != k:
-                raise ValueError(
-                    f"every slot must carry exactly {k} shares/coefficients"
-                )
+        rlc = era_rlc(slots, k, rng, masks)
         # the in-kernel tree reduce sums power-of-two groups of adjacent
         # lanes: pad each slot to the next power of two with flagged-out
         # filler lanes (zero digits -> infinity flags)
-        k_pad = 1 << max(0, k - 1).bit_length() if k > 1 else 1
+        k_pad = _pow2_at_least(k)
         pad = k_pad - k
         u_flat = [u for u_list, _ in slots for u in u_list + [bls.G1_INF] * pad]
         u_np = pg1.g1_pack(u_flat)
         y_dev = self.y_device(y_points, s)
-        rlc = [
-            [rng.randbelow((1 << 64) - 1) + 1 for _ in range(k)]
-            for _ in range(s)
-        ]
         rlc_flat = [c for row in rlc for c in row + [0] * pad]
         lag_flat = [
             c for _, lag_list in slots for c in lag_list + [0] * pad
@@ -288,6 +330,125 @@ class PallasEraPipeline:
                 )
             out.append((u_agg, y_agg, comb))
         return out, rlc
+
+
+class TsPallasPipeline:
+    """Coin-era pipeline on the Pallas G2 kernel (ops/pg2.py).
+
+    run_era(coins, y_points, rng, masks) where coins = [(sig_list, lag_row)]
+    per coin (K G2 signature shares + K Lagrange-at-0 coefficients) and
+    y_points = the K per-validator TS public keys (G1). Returns
+    (per-coin (sig_rlc_agg G2, y_rlc_agg G1, combined_sig G2), rlc).
+
+    The host finishes with e(g1, sig_agg) == e(y_agg, H(msg)) per coin —
+    ONE grand multi-pairing for all coins, versus the reference's 2
+    pairings per share (ThresholdSigner.cs:92-95) and serial G2 Lagrange
+    combine (PublicKeySet.cs:35-44)."""
+
+    def __init__(self, backend=None):
+        from ..crypto.provider import get_backend
+
+        self._backend = backend or get_backend()
+        self._y_cache = _TiledYCache()
+
+    def run_era(self, coins, y_points, rng, masks=None):
+        import jax.numpy as jnp
+
+        from . import pg1, pg2
+
+        s = len(coins)
+        k = len(y_points)
+        rlc = era_rlc(coins, k, rng, masks)
+        k_pad = _pow2_at_least(k)
+        pad = k_pad - k
+        sig_flat = [
+            p for sig_list, _ in coins for p in sig_list + [bls.G2_INF] * pad
+        ]
+        rlc_flat = [c for row in rlc for c in row + [0] * pad]
+        lag_flat = [c for _, lag in coins for c in lag + [0] * pad]
+        fused = pg2.ts_era_kernel_jit(
+            jnp.asarray(pg2.g2_pack(sig_flat)),
+            self._y_cache.get(y_points, s, k_pad),
+            jnp.asarray(pg1.digits_col(rlc_flat, pg2.W64)),
+            jnp.asarray(pg1.digits_col(lag_flat, pg2.W256)),
+            k_pad,
+        )
+        fused = np.asarray(fused)  # ONE device->host transfer
+        pr = pg2.POINT2_ROWS
+        pts, flags = fused[:pr], fused[pr] != 0
+        sig_cols = pg2.g2_unpack(pts[:, : 2 * s], flags[: 2 * s])
+        y_cols = pg1.g1_unpack(
+            pts[: pg1.POINT_ROWS, 2 * s :], flags[2 * s :]
+        )
+        out = []
+        for i in range(s):
+            comb = sig_cols[s + i]
+            if bls.g2_is_inf(comb) and any(c for c in coins[i][1]):
+                # incomplete-add collision in the combine lanes: no RLC
+                # soundness there, host-oracle fallback for this coin (same
+                # escape hatch as PallasEraPipeline.run_era)
+                sig_list, lag_list = coins[i]
+                comb = self._backend.g2_msm(
+                    [p for p, c in zip(sig_list, lag_list) if c],
+                    [c for c in lag_list if c],
+                )
+            out.append((sig_cols[i], y_cols[i], comb))
+        return out, rlc
+
+
+class _HostEraPipelineBase:
+    """Host-backend emulation of the device era-pipeline contract.
+
+    Same `run_era(slots, y_points, rng, masks)` signature and semantics as
+    the Pallas pipelines, computed with the host backend's MSMs; the share
+    group differs per subclass (`_share_msm`). Two jobs:
+      * CPU CI / non-TPU deployments: XLA-CPU compilation of the
+        interpret-mode Pallas kernels costs ~390 s per static shape, so
+        everything above the kernel boundary (aggregation, masking,
+        soundness decisions) runs — and stays covered — on this path.
+      * correctness oracle for the device pipelines.
+    Backend selection happens in crypto/tpu_backend.py: Pallas on a real
+    chip, this emulation elsewhere."""
+
+    _share_msm = "g1_msm"
+
+    def __init__(self, backend=None):
+        from ..crypto.provider import get_backend
+
+        self._backend = backend or get_backend()
+
+    def run_era(self, slots, y_points, rng, masks=None):
+        k = len(y_points)
+        rlc = era_rlc(slots, k, rng, masks)
+        share_msm = getattr(self._backend, self._share_msm)
+        out = []
+        for i, (pts_list, lag_list) in enumerate(slots):
+            live = [j for j, c in enumerate(rlc[i]) if c]
+            share_agg = share_msm(
+                [pts_list[j] for j in live], [rlc[i][j] for j in live]
+            )
+            y_agg = self._backend.g1_msm(
+                [y_points[j] for j in live], [rlc[i][j] for j in live]
+            )
+            comb_live = [j for j, c in enumerate(lag_list) if c]
+            comb = share_msm(
+                [pts_list[j] for j in comb_live],
+                [lag_list[j] for j in comb_live],
+            )
+            out.append((share_agg, y_agg, comb))
+        return out, rlc
+
+
+class HostEraPipeline(_HostEraPipelineBase):
+    """TPKE slots: shares are G1 points (see _HostEraPipelineBase)."""
+
+    _share_msm = "g1_msm"
+
+
+class TsHostEraPipeline(_HostEraPipelineBase):
+    """Coin slots: shares are G2 signatures (see _HostEraPipelineBase)."""
+
+    _share_msm = "g2_msm"
 
 
 class TpuTpkeVerifier:

@@ -1,0 +1,146 @@
+"""Pallas G2 kernel (ops/pg2.py) vs the host oracle.
+
+Mirror of tests/test_pg1.py for the Fp2/G2 engine: Fp2 mul/sqr fuzz, G2
+group-law fuzz, windowed G2 MSM with zero-lane flags, tree reduce, and the
+fused coin-era kernel on tiny shapes. On CPU the kernel bodies run as plain
+jnp (pg2.INTERPRET), so these tests validate the exact math that compiles
+on the chip.
+
+Conformance anchor: the reference's serial per-share coin path
+(ThresholdSignature/ThresholdSigner.cs:45-95, PublicKeySet.cs:35-44).
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.ops import msm, pg1, pg2
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0x6E2A)
+
+
+def _pack_fp2(vals):
+    """list of (c0, c1) -> two (44, n) jnp blocks."""
+    a = jnp.asarray(msm._ints_to_limbs_np([v[0] for v in vals]).T.copy())
+    b = jnp.asarray(msm._ints_to_limbs_np([v[1] for v in vals]).T.copy())
+    return a, b
+
+
+def _fp2_int(pair, i):
+    return (
+        pg1._limbs_int(np.asarray(pair[0])[:, i]),
+        pg1._limbs_int(np.asarray(pair[1])[:, i]),
+    )
+
+
+def test_fp2_mul_sqr_fuzz(rng):
+    n = 64
+    xs = [(rng.randrange(bls.P), rng.randrange(bls.P)) for _ in range(n)]
+    ys = [(rng.randrange(bls.P), rng.randrange(bls.P)) for _ in range(n)]
+    c = pg1._const_args()
+    out_m = pg2._fp2_mul(_pack_fp2(xs), _pack_fp2(ys), c)
+    out_s = pg2._fp2_sqr(_pack_fp2(xs), c)
+    for i in range(n):
+        assert _fp2_int(out_m, i) == bls.fp2_mul(xs[i], ys[i])
+        assert _fp2_int(out_s, i) == bls.fp2_sqr(xs[i])
+    # magnitude invariant: outputs stay within the loose-limb bound the
+    # conv accumulators assume (44 * bound^2 < 2^31)
+    for comp in (*out_m, *out_s):
+        assert np.abs(np.asarray(comp)).max() < 1 << 13
+
+
+def test_g2_dbl_add_vs_oracle(rng):
+    n = 8
+    pts = [bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
+    qts = [bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
+    pd, qd = jnp.asarray(pg2.g2_pack(pts)), jnp.asarray(pg2.g2_pack(qts))
+    d_out = pg2.g2_unpack(np.asarray(pg2.pl_dbl2(pd)))
+    a_out = pg2.g2_unpack(np.asarray(pg2.pl_add2(pd, qd)))
+    for i in range(n):
+        assert bls.g2_eq(d_out[i], bls.g2_dbl(pts[i]))
+        assert bls.g2_eq(a_out[i], bls.g2_add(pts[i], qts[i]))
+
+
+def test_g2_pack_roundtrip(rng):
+    pts = [bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(4)]
+    pts.append(bls.G2_INF)
+    back = pg2.g2_unpack(pg2.g2_pack(pts))
+    for p, q in zip(pts, back):
+        assert bls.g2_eq(p, q)
+
+
+def test_msm2_windowed_vs_oracle(rng):
+    """Short (16-bit) scalars keep the CPU suite fast while driving the
+    identical kernel body the chip compiles."""
+    n = 8
+    pts = [bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
+    scalars = [rng.randrange(1, 1 << 16) for _ in range(n)]
+    scalars[2] = 0  # zero lane comes back flagged infinity
+    dig = jnp.asarray(pg1.digits_col(scalars, 4))
+    acc, flags = pg2.msm2_windowed(jnp.asarray(pg2.g2_pack(pts)), dig)
+    got = pg2.g2_unpack(np.asarray(acc), np.asarray(flags))
+    for i in range(n):
+        assert bls.g2_eq(got[i], bls.g2_mul(pts[i], scalars[i])), i
+    assert bool(np.asarray(flags)[2])
+
+
+def test_tree_reduce2_flags(rng):
+    n = 8
+    pts = [bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
+    flags = np.zeros(n, bool)
+    flags[1] = flags[6] = True
+    acc, fl = pg2.tree_reduce2_k(
+        jnp.asarray(pg2.g2_pack(pts)), jnp.asarray(flags), n
+    )
+    want = bls.G2_INF
+    for i, p in enumerate(pts):
+        if not flags[i]:
+            want = bls.g2_add(want, p)
+    got = pg2.g2_unpack(np.asarray(acc), np.asarray(fl))[0]
+    assert bls.g2_eq(got, want)
+
+
+def test_ts_era_kernel_tiny(rng):
+    """Fused coin-era kernel at S=2, K=4 with short scalars: per-slot G2
+    RLC aggregates, G2 Lagrange combines, and G1 key RLC aggregates."""
+    s, k = 2, 4
+    n = s * k
+    sig_pts = [
+        bls.g2_mul(bls.G2_GEN, rng.randrange(1, bls.R)) for _ in range(n)
+    ]
+    y_pts = [
+        bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R)) for _ in range(n)
+    ]
+    rlc = [rng.randrange(1, 1 << 16) for _ in range(n)]
+    lag = [rng.randrange(1, 1 << 16) if i % k != 2 else 0 for i in range(n)]
+    fused = np.asarray(
+        pg2.ts_era_kernel(
+            jnp.asarray(pg2.g2_pack(sig_pts)),
+            jnp.asarray(pg1.g1_pack(y_pts)),
+            jnp.asarray(pg1.digits_col(rlc, 4)),
+            jnp.asarray(pg1.digits_col(lag, 4)),
+            k,
+        )
+    )
+    pr = pg2.POINT2_ROWS
+    pts, flags = fused[:pr], fused[pr] != 0
+    sig_cols = pg2.g2_unpack(pts[:, : 2 * s], flags[: 2 * s])
+    y_cols = pg1.g1_unpack(pts[:132, 2 * s :], flags[2 * s :])
+    for si in range(s):
+        sig_r = sig_l = bls.G2_INF
+        y_r = bls.G1_INF
+        for i in range(si * k, (si + 1) * k):
+            sig_r = bls.g2_add(sig_r, bls.g2_mul(sig_pts[i], rlc[i]))
+            sig_l = bls.g2_add(sig_l, bls.g2_mul(sig_pts[i], lag[i]))
+            y_r = bls.g1_add(y_r, bls.g1_mul(y_pts[i], rlc[i]))
+        assert bls.g2_eq(sig_cols[si], sig_r)
+        assert bls.g2_eq(sig_cols[s + si], sig_l)
+        assert bls.g1_eq(y_cols[si], y_r)
