@@ -67,12 +67,15 @@ class Protocol:
         self._result_emitted = False
         self._parent: Optional[Any] = None
         # liveness breadcrumbs for the 60s stall watchdog (reference
-        # AbstractProtocol._lastMessage, AbstractProtocol.cs:36-38, 113-135)
+        # AbstractProtocol._lastMessage, AbstractProtocol.cs:36-38, 113-135).
+        # Only interned type-name strings are kept (an f-string per message
+        # costs more than most handlers at N=64 scale; retaining the raw
+        # envelope would pin its payload for the protocol's lifetime)
         import time as _time
 
         self.started_at = _time.monotonic()
         self.last_activity = self.started_at
-        self.last_message: str = "<created>"
+        self._last_kind: Optional[tuple] = None
 
     # -- runtime ------------------------------------------------------------
     def receive(self, envelope) -> None:
@@ -80,16 +83,15 @@ class Protocol:
         protocol (reference: AbstractProtocol.cs:137-146)."""
         if self.terminated:
             return
-        import time as _time
-
         from ..utils import metrics
 
-        metrics.inc("consensus_messages_processed")
-        self.last_activity = _time.monotonic()
-        self.last_message = type(envelope).__name__ + (
-            f":{type(envelope.payload).__name__}"
+        metrics.MESSAGES_PROCESSED[0] += 1
+        self.last_activity = metrics.monotonic()
+        self._last_kind = (
+            type(envelope).__name__,
+            type(envelope.payload).__name__
             if isinstance(envelope, M.External)
-            else ""
+            else None,
         )
         try:
             if isinstance(envelope, M.External):
@@ -135,6 +137,14 @@ class Protocol:
 
     def handle_child_result(self, child_id, value) -> None:
         pass
+
+    @property
+    def last_message(self) -> str:
+        """Watchdog breadcrumb, rendered on demand."""
+        if self._last_kind is None:
+            return "<created>"
+        kind, payload = self._last_kind
+        return kind if payload is None else f"{kind}:{payload}"
 
     # -- helpers ------------------------------------------------------------
     @property

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import enum
 import random
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import messages as M
@@ -50,7 +51,13 @@ class SimulatedNetwork:
         self.mode = mode
         self.repeat_probability = repeat_probability
         self.muted = muted or set()
-        self._queue: List[Tuple[int, int, Any]] = []  # (sender, target, payload)
+        # (sender, target, payload). Container picked per mode so every
+        # _pop is O(1) at 2M-message eras (N=64): deque for FIFO/LIFO
+        # (popleft/pop), plain list for RANDOM (indexed swap-with-last +
+        # pop from the end — deque middle indexing is O(n))
+        self._queue = (
+            [] if mode is DeliveryMode.TAKE_RANDOM else deque()
+        )
         self.routers: List[EraRouter] = []
         for i in range(self.n):
             self.routers.append(
@@ -80,12 +87,19 @@ class SimulatedNetwork:
     # -- adversarial queue ----------------------------------------------------
     def _pop(self) -> Tuple[int, int, Any]:
         if self.mode is DeliveryMode.TAKE_FIRST:
-            idx = 0
+            item = self._queue.popleft()
         elif self.mode is DeliveryMode.TAKE_LAST:
-            idx = len(self._queue) - 1
+            item = self._queue.pop()
         else:
+            # uniform random choice via swap-with-last + list pop: O(1);
+            # surviving order is irrelevant under random selection
             idx = self.rng.randrange(len(self._queue))
-        item = self._queue.pop(idx)
+            last = self._queue.pop()
+            if idx < len(self._queue):
+                item = self._queue[idx]
+                self._queue[idx] = last
+            else:
+                item = last
         if self.repeat_probability > 0 and self.rng.random() < self.repeat_probability:
             self._queue.append(item)  # duplicate injection
         return item

@@ -32,6 +32,7 @@ from .types import (
     TransactionReceipt,
     ZERO_HASH,
     tx_merkle_root,
+    warm_sender_caches,
 )
 
 
@@ -99,6 +100,9 @@ class BlockManager:
         # block exec metrics (reference Prometheus summaries,
         # BlockManager.cs:62-127)
         with metrics.measure("block_execute"):
+            # batch-recover every sender up front (threaded native entry);
+            # ordering + execution then hit warm caches only
+            warm_sender_caches(txs, self.executer.chain_id)
             txs = self.order_transactions(txs, self.executer.chain_id)
             em = self.emulate(txs, header.index)
             if check_state_hash and em.state_hash != header.state_hash:

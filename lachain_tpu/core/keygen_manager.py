@@ -22,8 +22,16 @@ from typing import Callable, Dict, List, Optional
 
 from ..consensus.keygen import CommitMessage, ThresholdKeyring, TrustlessKeygen, ValueMessage
 from ..crypto import ecdsa
+from ..storage.kv import EntryPrefix, prefixed
 from ..storage.state import Snapshot
-from ..utils.serialization import Reader, write_bytes, write_u32, write_u256
+from ..utils.serialization import (
+    Reader,
+    write_bytes,
+    write_bytes_list,
+    write_u32,
+    write_u64,
+    write_u256,
+)
 from . import system_contracts as sc
 from .types import Block
 
@@ -39,6 +47,7 @@ class KeyGenManager:
         cycle_duration: Optional[int] = None,
         on_keys: Optional[Callable[[int, ThresholdKeyring, List[bytes]], None]] = None,
         rng=None,
+        kv=None,
     ):
         self._priv = ecdsa_priv
         self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
@@ -53,6 +62,65 @@ class KeyGenManager:
         self._keyring: Optional[ThresholdKeyring] = None
         self._cycle: Optional[int] = None
         self._installed_cycles: set = set()
+        # crash durability: the full DKG state persists after EVERY step so
+        # a validator restarting mid-keygen rejoins the cycle instead of
+        # losing its slot (reference persists via KeyGenRepository after
+        # each handler, ThresholdKeygen/TrustlessKeygen.cs:195-261 +
+        # ConsensusManager.cs:250-266 rescan)
+        self._kv = kv
+        if kv is not None:
+            self._load_state()
+
+    _STATE_KEY = prefixed(EntryPrefix.KEYGEN_STATE)
+
+    def _persist_state(self) -> None:
+        if self._kv is None:
+            return
+        out = write_u64(
+            self._cycle if self._cycle is not None else (1 << 64) - 1
+        )
+        out += write_bytes_list(list(self._participants))
+        out += write_bytes(self.keygen.to_bytes() if self.keygen else b"")
+        out += write_u32(len(self._installed_cycles))
+        for c in sorted(self._installed_cycles):
+            out += write_u64(c)
+        self._kv.put(self._STATE_KEY, out)
+
+    def _load_state(self) -> None:
+        raw = self._kv.get(self._STATE_KEY)
+        if raw is None:
+            return
+        try:
+            r = Reader(raw)
+            cycle = r.u64()
+            self._cycle = None if cycle == (1 << 64) - 1 else cycle
+            self._participants = r.bytes_list()
+            blob = r.bytes_()
+            self._installed_cycles = {r.u64() for _ in range(r.u32())}
+            r.assert_eof()
+            self._addr_to_idx = {
+                ecdsa.address_from_public_key(pk): i
+                for i, pk in enumerate(self._participants)
+            }
+            if blob:
+                self.keygen = TrustlessKeygen.from_bytes(blob, self._priv)
+                self._keyring = self.keygen.try_get_keys()
+            logger.info(
+                "keygen state restored (cycle %s, in progress: %s)",
+                self._cycle,
+                self.keygen is not None,
+            )
+        except Exception:
+            logger.exception("corrupt keygen state ignored")
+            # reset EVERY restored field to pristine values — partially
+            # restored cycle/participant/installed-cycle garbage could
+            # silently skip the next key installation
+            self.keygen = None
+            self._keyring = None
+            self._cycle = None
+            self._participants = []
+            self._addr_to_idx = {}
+            self._installed_cycles = set()
 
     # -- block hook ---------------------------------------------------------
 
@@ -117,6 +185,7 @@ class KeyGenManager:
         participants = Reader(raw).bytes_list()
         if self.public_key not in participants:
             self.keygen = None
+            self._persist_state()
             return
         cycle = block.header.index // self._cycle_duration
         if self._cycle == cycle and self.keygen is not None:
@@ -135,6 +204,7 @@ class KeyGenManager:
         )
         self._keyring = None
         commit = self.keygen.start_keygen()
+        self._persist_state()
         logger.info("elected for cycle %d: sending keygen commit", cycle)
         self._send_tx(
             sc.GOVERNANCE_ADDRESS,
@@ -152,6 +222,7 @@ class KeyGenManager:
         except ValueError:
             logger.warning("faulty commit from dealer %d ignored", dealer)
             return
+        self._persist_state()
         self._send_tx(
             sc.GOVERNANCE_ADDRESS,
             sc.SEL_KEYGEN_SEND_VALUE
@@ -172,6 +243,7 @@ class KeyGenManager:
         except ValueError:
             logger.warning("faulty value from sender %d ignored", sender)
             return
+        self._persist_state()
         if not should_confirm:
             return
         keyring = self.keygen.try_get_keys()
@@ -190,6 +262,7 @@ class KeyGenManager:
         if self._cycle in self._installed_cycles:
             return
         self._installed_cycles.add(self._cycle)
+        self._persist_state()
         first_era = (self._cycle + 1) * self._cycle_duration
         logger.info("keygen finished: keys installed from era %d", first_era)
         if self._on_keys is not None:

@@ -24,7 +24,7 @@ from ..crypto import ecdsa
 from ..network import wire
 from ..network.hub import PeerAddress
 from ..network.manager import NetworkManager
-from ..storage.kv import KVStore, MemoryKV
+from ..storage.kv import EntryPrefix, KVStore, MemoryKV, prefixed
 from ..storage.state import StateManager
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
@@ -32,7 +32,13 @@ from .execution import TransactionExecuter, get_nonce
 from .keygen_manager import KeyGenManager
 from .synchronizer import BlockSynchronizer
 from .tx_pool import TransactionPool
-from .types import Block, SignedTransaction, Transaction, sign_transaction
+from .types import (
+    Block,
+    SignedTransaction,
+    Transaction,
+    sign_transaction,
+    warm_sender_caches,
+)
 from .validator_manager import ValidatorManager
 from .validator_status import ValidatorStatusManager
 from .vault import PrivateWallet
@@ -128,10 +134,29 @@ class Node:
             private_keys.ecdsa_priv,
             self._send_system_tx,
             on_keys=self._install_rotated_keys,
+            kv=self.kv,
         )
         self.validator_status = ValidatorStatusManager(
             private_keys.ecdsa_priv, self._send_system_tx
         )
+        # per-cycle signed-header attendance, durable across restarts
+        # (reference: ValidatorAttendance persisted from RootProtocol
+        # signed headers, RootProtocol.cs:302-303 +
+        # ValidatorAttendanceRepository)
+        from ..consensus.attendance import ValidatorAttendance
+        from . import system_contracts as _sc
+
+        att_raw = self.kv.get(prefixed(EntryPrefix.VALIDATOR_ATTENDANCE))
+        cur_cycle = self.block_manager.current_height() // _sc.CYCLE_DURATION
+        if att_raw is not None:
+            try:
+                self.attendance = ValidatorAttendance.from_bytes(
+                    att_raw, cur_cycle, current_as_next=False
+                )
+            except Exception:
+                self.attendance = ValidatorAttendance(cur_cycle)
+        else:
+            self.attendance = ValidatorAttendance(cur_cycle)
         self.block_manager.on_block_persisted.append(self._on_block_persisted)
         self._height_event = asyncio.Event()
         # target era pacing for the autonomous loop (reference
@@ -239,7 +264,13 @@ class Node:
         return ok
 
     def _on_pool_txs(self, sender: bytes, txs: List[SignedTransaction]) -> None:
-        for stx in txs:
+        # gossip batches arrive many-at-once: batch-recover senders, but
+        # ONLY for txs that pass the pool's cheap dedup/gas checks first —
+        # a re-gossiped duplicate batch must cost hash lookups, not ECDSA
+        # recoveries (DoS surface otherwise)
+        fresh = [stx for stx in txs if self.pool.precheck(stx)]
+        warm_sender_caches(fresh, self.chain_id)
+        for stx in fresh:
             self.pool.add(stx)
 
     def _on_ping_request(self, sender: bytes, height: int) -> None:
@@ -453,7 +484,32 @@ class Node:
         snap = self.state.new_snapshot()
         self.validator_status.on_block_persisted(block, snap)
         self.keygen_manager.on_block_persisted(block, snap)
+        self._record_attendance(block)
         self._height_event.set()
+
+    def _record_attendance(self, block: Block) -> None:
+        """Count each multisig signer's co-signature for the block's cycle
+        and persist (reference: ValidatorAttendance.IncrementAttendance via
+        RootProtocol.cs:302-303, durable in the attendance repository)."""
+        from . import system_contracts as _sc
+
+        keys = self.validator_manager.keys_for_era(block.header.index)
+        if keys is None:
+            return
+        cycle = block.header.index // _sc.CYCLE_DURATION
+        if cycle > self.attendance.next_cycle:
+            from ..consensus.attendance import ValidatorAttendance
+
+            self.attendance = ValidatorAttendance.from_bytes(
+                self.attendance.to_bytes(), cycle, current_as_next=False
+            )
+        for idx, _sig in block.multisig.signatures:
+            if 0 <= idx < len(keys.ecdsa_pub_keys):
+                self.attendance.increment(keys.ecdsa_pub_keys[idx], cycle)
+        self.kv.put(
+            prefixed(EntryPrefix.VALIDATOR_ATTENDANCE),
+            self.attendance.to_bytes(),
+        )
 
     async def _wait_height(self, height: int) -> None:
         while (

@@ -41,6 +41,17 @@ class TransactionPool:
         return len(self._txs)
 
     # -- ingress --------------------------------------------------------------
+    def precheck(self, stx: SignedTransaction) -> bool:
+        """The cheap admission checks only (dedup + gas floor) — no
+        signature recovery. Bulk-ingest callers filter through this BEFORE
+        paying for batch sender recovery, so re-gossiped duplicates cost a
+        hash lookup, not an ECDSA recover."""
+        with self._lock:
+            return (
+                stx.hash() not in self._txs
+                and stx.tx.gas_price >= self.min_gas_price
+            )
+
     def add(self, stx: SignedTransaction) -> bool:
         """Verify + admit. Returns False (and drops) on any rule violation."""
         h = stx.hash()

@@ -99,6 +99,31 @@ class SignedTransaction:
         return addr
 
 
+def warm_sender_caches(stxs, chain_id: int) -> None:
+    """Batch-recover senders for many transactions at once through the
+    native threaded entry (ecdsa.recover_hash_batch) and populate each
+    tx's sender cache — the pool/sync bulk-ingest fast path (role of the
+    reference's background TransactionVerifier,
+    Blockchain/Operations/TransactionVerifier.cs:23-72). Safe to call with
+    any mix: already-cached txs are skipped, invalid signatures cache a
+    None sender exactly like the scalar path."""
+    pending = [
+        stx
+        for stx in stxs
+        if (c := stx.__dict__.get("_sender_cache")) is None
+        or c[0] != chain_id
+    ]
+    if not pending:
+        return
+    pubs = ecdsa.recover_hash_batch(
+        [stx.tx.signing_hash(chain_id) for stx in pending],
+        [stx.signature for stx in pending],
+    )
+    for stx, pub in zip(pending, pubs):
+        addr = None if pub is None else ecdsa.address_from_public_key(pub)
+        object.__setattr__(stx, "_sender_cache", (chain_id, addr))
+
+
 def sign_transaction(
     tx: Transaction, priv: bytes, chain_id: int
 ) -> SignedTransaction:

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from .hashes import keccak256
 
@@ -282,6 +282,52 @@ def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
             return bytes(out)
         return None
     return _recover_hash_py(msg_hash, sig)
+
+
+@metrics.timed("crypto_ec_recover_batch")
+def recover_hash_batch(
+    hashes: Sequence[bytes],
+    sigs: Sequence[bytes],
+    nthreads: Optional[int] = None,
+) -> List[Optional[bytes]]:
+    """Recover many signatures at once through the native threaded batch
+    entry (lt_ec_recover_batch) — the pool-ingest path (role of the
+    reference's background TransactionVerifier,
+    Blockchain/Operations/TransactionVerifier.cs:23-72). Threads scale on
+    multi-core hosts; on this 1-core CI box the win is the amortized
+    fixed-base G table + windowed multiplies (~2x vs round 2). Entries
+    with non-standard lengths fall back to the scalar path."""
+    import os as _os
+
+    n = len(hashes)
+    if n != len(sigs):
+        raise ValueError("hashes/sigs length mismatch")
+    lib = _native_lib()
+    regular = [
+        i
+        for i in range(n)
+        if len(hashes[i]) == 32 and len(sigs[i]) == 65
+    ]
+    out: List[Optional[bytes]] = [None] * n
+    if lib is None or not regular:
+        return [recover_hash(h, s) for h, s in zip(hashes, sigs)]
+    import ctypes as _ct
+
+    hb = b"".join(hashes[i] for i in regular)
+    sb = b"".join(sigs[i] for i in regular)
+    m = len(regular)
+    outs = _ct.create_string_buffer(33 * m)
+    oks = _ct.create_string_buffer(m)
+    nt = nthreads or min(_os.cpu_count() or 1, 16)
+    lib.lt_ec_recover_batch(hb, sb, m, nt, outs, oks)
+    for pos, i in enumerate(regular):
+        if oks.raw[pos] == 1:
+            out[i] = outs.raw[33 * pos : 33 * pos + 33]
+    regular_set = set(regular)
+    for i in range(n):
+        if i not in regular_set:
+            out[i] = recover_hash(hashes[i], sigs[i])
+    return out
 
 
 def _recover_hash_py(msg_hash: bytes, sig: bytes) -> Optional[bytes]:

@@ -35,7 +35,13 @@ from .provider import batch_bisect_verify, get_backend, select_distinct
 _SIG_DOMAIN = b"LTPU-TSIG"
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def _hash_to_sig_point(msg: bytes) -> tuple:
+    """Memoized: every sign/verify/combine of one coin re-hashes the same
+    coin id (N+1 times per coin per validator at N=64)."""
     return get_backend().hash_to_g2(msg, _SIG_DOMAIN)
 
 

@@ -47,7 +47,16 @@ def _pad(y_r_point: tuple, nbytes: int) -> bytes:
     return xof(_ENC_DOMAIN, bls.g1_to_bytes(y_r_point), nbytes)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def _hash_uv_to_g2(u: tuple, v: bytes) -> tuple:
+    """Memoized: one ciphertext's H point is consulted for every decrypt/
+    verify/combine touching it — dozens of times per era at N=64. Keyed on
+    the raw Jacobian tuple: a different representative of the same point
+    just misses and recomputes (hash_to_g2 is deterministic), never
+    produces a wrong value."""
     return get_backend().hash_to_g2(
         bls.g1_to_bytes(u) + v, _HW_DOMAIN
     )

@@ -78,7 +78,26 @@ class TpuBackend:
 
     name = "tpu"
 
-    def __init__(self, host_backend=None, pipeline=None, ts_pipeline=None):
+    def __init__(
+        self,
+        host_backend=None,
+        pipeline=None,
+        ts_pipeline=None,
+        min_device_lanes=None,
+    ):
+        import os
+
+        # below this many kernel lanes (S_pad x K_pad) an era batch runs on
+        # the host pipeline even when a chip is present: per-call device
+        # overhead (the axon tunnel charges ~0.1 s fixed) plus one-time
+        # per-shape Mosaic compiles dwarf the host cost of tiny batches.
+        # The S x K era shapes the kernels exist for (N=64 -> 4096 lanes)
+        # clear this easily.
+        if min_device_lanes is None:
+            min_device_lanes = int(
+                os.environ.get("LTPU_TPU_MIN_LANES", "1024")
+            )
+        self.min_device_lanes = min_device_lanes
         if host_backend is None:
             try:
                 from .native_backend import NativeBackend
@@ -91,6 +110,8 @@ class TpuBackend:
         self._host = host_backend
         self._pipeline = pipeline  # lazy PallasEraPipeline (G1/TPKE)
         self._ts_pipeline = ts_pipeline  # lazy TsPallasPipeline (G2/coins)
+        self._host_pipeline = None
+        self._ts_host_pipeline = None
         self._y_cache: dict = {}
         # observability: proves the device path executed (asserted by tests
         # and exported through /metrics)
@@ -98,6 +119,7 @@ class TpuBackend:
         self.era_slots_total = 0
         self.ts_era_calls = 0
         self.ts_era_coins_total = 0
+        self.device_msm_calls = 0
 
     def __getattr__(self, item):
         # only consulted for attributes NOT defined on TpuBackend: pairings,
@@ -142,6 +164,88 @@ class TpuBackend:
             else:
                 self._ts_pipeline = TsHostEraPipeline(self._host)
         return self._ts_pipeline
+
+    def _device_ok(self, n: int) -> bool:
+        if n < self.min_device_lanes:
+            return False
+        import os
+
+        import jax
+
+        return (
+            jax.default_backend() == "tpu"
+            or os.environ.get("LTPU_FORCE_PALLAS") == "1"
+        )
+
+    def g1_msm(self, points, scalars):
+        """Large MSMs ride the Pallas G1 engine; small ones go host. This
+        is how TPKE batch_verify_shares/full_decrypt and the TS key
+        aggregates hit the chip without their callers changing — the same
+        provider-seam trick the reference's MCL swap uses."""
+        if not self._device_ok(len(points)):
+            return self._host.g1_msm(points, scalars)
+        try:
+            return self._device_msm(points, scalars, g2=False)
+        except Exception:
+            metrics.inc("crypto_tpu_msm_fallbacks")
+            return self._host.g1_msm(points, scalars)
+
+    def g2_msm(self, points, scalars):
+        """Large G2 MSMs (ThresholdSigner prune paths, TS combine at big N)
+        ride the Pallas G2 engine (ops/pg2.py); small ones go host."""
+        if not self._device_ok(len(points)):
+            return self._host.g2_msm(points, scalars)
+        try:
+            return self._device_msm(points, scalars, g2=True)
+        except Exception:
+            metrics.inc("crypto_tpu_msm_fallbacks")
+            return self._host.g2_msm(points, scalars)
+
+    def _device_msm(self, points, scalars, g2: bool):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import pg1, pg2
+        from ..ops.verify import _pow2_at_least
+
+        n = len(points)
+        n_pad = _pow2_at_least(n)
+        inf = bls.G2_INF if g2 else bls.G1_INF
+        pts = list(points) + [inf] * (n_pad - n)
+        ss = [s % bls.R for s in scalars] + [0] * (n_pad - n)
+        dig = jnp.asarray(pg1.digits_col(ss, 64))  # 256-bit windows
+        if g2:
+            fused = np.asarray(
+                pg2.msm2_reduce_jit(
+                    jnp.asarray(pg2.g2_pack(pts)), dig, n_pad
+                )
+            )
+            pr = pg2.POINT2_ROWS
+            out = pg2.g2_unpack(fused[:pr], fused[pr] != 0)
+        else:
+            fused = np.asarray(
+                pg1.msm_reduce_jit(
+                    jnp.asarray(pg1.g1_pack(pts)), dig, n_pad
+                )
+            )
+            out = pg1.g1_unpack(fused[:132], fused[132] != 0)
+        metrics.inc("crypto_tpu_device_msm_calls")
+        self.device_msm_calls += 1
+        return out[0]
+
+    def _get_host_pipeline(self):
+        if self._host_pipeline is None:
+            from ..ops.verify import HostEraPipeline
+
+            self._host_pipeline = HostEraPipeline(self._host)
+        return self._host_pipeline
+
+    def _get_ts_host_pipeline(self):
+        if self._ts_host_pipeline is None:
+            from ..ops.verify import TsHostEraPipeline
+
+            self._ts_host_pipeline = TsHostEraPipeline(self._host)
+        return self._ts_host_pipeline
 
     def _stable_y_points(self, vks, attr: str = "y_i") -> list:
         """One stable y-point list per verification-key list so the
@@ -188,6 +292,7 @@ class TpuBackend:
             y_points=self._stable_y_points(verification_keys),
             inf_point=bls.G1_INF,
             pipeline_getter=self._get_pipeline,
+            host_pipeline_getter=self._get_host_pipeline,
             pairs_for=lambda job, agg: [
                 (agg[0], job.h),
                 (bls.g1_neg(agg[1]), job.w),
@@ -201,7 +306,7 @@ class TpuBackend:
 
     def _run_era_batch(
         self, jobs, rows, lags, y_points, inf_point, pipeline_getter,
-        pairs_for, rng,
+        host_pipeline_getter, pairs_for, rng,
     ) -> List[Tuple[bool, Optional[tuple]]]:
         """Shared engine for both era ops: mask absent lanes, pad the slot
         axis to a power of two with fully-masked dummy slots (bounds the
@@ -230,9 +335,12 @@ class TpuBackend:
         for _ in range(_pow2_at_least(s) - s):
             slots.append(([inf_point] * k, [0] * k))
             masks.append([False] * k)
-        aggs, _rlc = pipeline_getter().run_era(
-            slots, y_points, rng, masks=masks
-        )
+        lanes = _pow2_at_least(s) * _pow2_at_least(k)
+        if lanes >= self.min_device_lanes:
+            pipeline = pipeline_getter()
+        else:
+            pipeline = host_pipeline_getter()
+        aggs, _rlc = pipeline.run_era(slots, y_points, rng, masks=masks)
 
         def group_ok(idx: List[int]) -> bool:
             pairs = []
@@ -276,6 +384,7 @@ class TpuBackend:
             y_points=self._stable_y_points(ts_public_keys, attr="y"),
             inf_point=bls.G2_INF,
             pipeline_getter=self._get_ts_pipeline,
+            host_pipeline_getter=self._get_ts_host_pipeline,
             pairs_for=lambda job, agg: [
                 (bls.G1_GEN, agg[0]),
                 (bls.g1_neg(agg[1]), job.h),

@@ -557,6 +557,21 @@ def era_kernel_packed(buf, y, k: int, n: int):
 era_kernel_packed_jit = jax.jit(era_kernel_packed, static_argnames=("k", "n"))
 
 
+def msm_reduce(lanes, digits, k: int):
+    """Windowed MSM + full tree reduce fused into one device program
+    (single launch: on the axon tunnel every eager op is a network round
+    trip, so the composed-eager version of this costs ~1000x more wall
+    clock than the math). Returns (133, n/k): points + flag row."""
+    acc, fl = msm_windowed(lanes, digits)
+    out, ofl = tree_reduce_k(acc, fl, k)
+    return jnp.concatenate(
+        [out, ofl.astype(jnp.int32)[None, :]], axis=0
+    )
+
+
+msm_reduce_jit = jax.jit(msm_reduce, static_argnames=("k",))
+
+
 # ---------------------------------------------------------------------------
 # host marshal (plain form: no Montgomery scale, no batch inversion)
 # ---------------------------------------------------------------------------

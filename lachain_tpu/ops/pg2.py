@@ -443,6 +443,19 @@ def ts_era_kernel(sig, y, rlc16, lag64, k: int):
 ts_era_kernel_jit = jax.jit(ts_era_kernel, static_argnames=("k",))
 
 
+def msm2_reduce(lanes, digits, k: int):
+    """G2 windowed MSM + tree reduce as ONE device program (see
+    pg1.msm_reduce for why). Returns (289, n/k): points + flag row."""
+    acc, fl = msm2_windowed(lanes, digits)
+    out, ofl = tree_reduce2_k(acc, fl, k)
+    return jnp.concatenate(
+        [out, ofl.astype(jnp.int32)[None, :]], axis=0
+    )
+
+
+msm2_reduce_jit = jax.jit(msm2_reduce, static_argnames=("k",))
+
+
 # ---------------------------------------------------------------------------
 # host marshal
 # ---------------------------------------------------------------------------

@@ -135,3 +135,52 @@ def test_native_backend_matches_python_oracle():
         bad = bytearray(sig)
         bad[3] ^= 1
         assert not verify_hash(pub, h, bytes(bad))
+
+
+def test_recover_hash_batch_matches_scalar():
+    """Threaded batch entry (lt_ec_recover_batch) vs per-call recovery,
+    including an invalid signature and a malformed-length one."""
+    import random
+
+    from lachain_tpu.crypto import ecdsa
+
+    rng = random.Random(11)
+    privs = [ecdsa.generate_private_key() for _ in range(6)]
+    hashes = [bytes([rng.randrange(256) for _ in range(32)]) for _ in privs]
+    sigs = [ecdsa.sign_hash(p, h) for p, h in zip(privs, hashes)]
+    bad = bytearray(sigs[2])
+    bad[5] ^= 0xFF
+    sigs[2] = bytes(bad)
+    sigs[4] = sigs[4][:40]  # malformed length -> scalar fallback lane
+    got = ecdsa.recover_hash_batch(hashes, sigs)
+    want = [ecdsa.recover_hash(h, s) for h, s in zip(hashes, sigs)]
+    assert got == want
+    assert got[0] == ecdsa.public_key_bytes(privs[0])
+    assert got[4] is None
+
+
+def test_warm_sender_caches():
+    from lachain_tpu.core.types import (
+        Transaction,
+        sign_transaction,
+        warm_sender_caches,
+    )
+    from lachain_tpu.crypto import ecdsa
+
+    chain_id = 77
+    privs = [ecdsa.generate_private_key() for _ in range(4)]
+    stxs = [
+        sign_transaction(
+            Transaction(to=b"\x01" * 20, value=5, nonce=0, gas_price=1,
+                        gas_limit=21000),
+            p,
+            chain_id,
+        )
+        for p in privs
+    ]
+    warm_sender_caches(stxs, chain_id)
+    for p, stx in zip(privs, stxs):
+        cached = stx.__dict__.get("_sender_cache")
+        assert cached is not None and cached[0] == chain_id
+        want = ecdsa.address_from_public_key(ecdsa.public_key_bytes(p))
+        assert stx.sender(chain_id) == want

@@ -229,3 +229,146 @@ def test_full_cycle_rotation_produces_working_keys():
         assert not w.has_keys_for_era(19)
     finally:
         sc.set_cycle_params(1000, 500)
+
+
+@pytest.mark.slow
+def test_keygen_manager_survives_restart_mid_dkg():
+    """Kill-and-restart durability (reference: state persisted after every
+    DKG step via KeyGenRepository, TrustlessKeygen.cs:195-261; rescan at
+    era start, ConsensusManager.cs:250-266): participant 0's manager is
+    torn down right after the COMMIT round and rebuilt from its KV store;
+    the cycle must still complete with all participants deriving the same
+    rotated key set."""
+    sc.set_cycle_params(20, 10)
+    try:
+        n_part = 4
+        privs = [ecdsa.generate_private_key(Rng(300 + i)) for i in range(n_part)]
+        addrs = [
+            ecdsa.address_from_public_key(ecdsa.public_key_bytes(p))
+            for p in privs
+        ]
+        chain = ChainHarness(privs, {a: 10**24 for a in addrs})
+        installed = {}
+
+        def on_keys_for(i):
+            def cb(first_era, keyring, participants):
+                installed[i] = (first_era, keyring, participants)
+
+            return cb
+
+        kvs = [MemoryKV() for _ in range(n_part)]
+
+        def make_kgm(i):
+            return KeyGenManager(
+                privs[i],
+                chain.send_tx_for(privs[i]),
+                on_keys=on_keys_for(i),
+                rng=Rng(800 + i),
+                kv=kvs[i],
+            )
+
+        vsms = [
+            ValidatorStatusManager(privs[i], chain.send_tx_for(privs[i]))
+            for i in range(n_part)
+        ]
+        kgms = [make_kgm(i) for i in range(n_part)]
+
+        def after_block(block):
+            snap = chain.state.new_snapshot()
+            for vsm in vsms:
+                vsm.on_block_persisted(block, snap)
+            for kgm in kgms:
+                kgm.on_block_persisted(block, snap)
+
+        for vsm in vsms:
+            vsm.become_staker(10**20)
+        while chain.bm.current_height() < 10:
+            after_block(chain.produce_block())
+        chain.send_tx_for(privs[0])(
+            sc.STAKING_ADDRESS, sc.SEL_FINISH_LOTTERY + b""
+        )
+        # lottery_done executes; every manager starts its keygen + COMMITs
+        after_block(chain.produce_block())
+        assert kgms[0].keygen is not None, "DKG should be running"
+        # one more block: commits execute, SEND_VALUEs queued — then CRASH
+        after_block(chain.produce_block())
+        kgms[0] = make_kgm(0)  # fresh process, same durable kv
+        assert kgms[0].keygen is not None, "restart lost the DKG state"
+        # remaining rounds play out with the restarted manager
+        for _ in range(6):
+            after_block(chain.produce_block())
+
+        assert 0 in installed, "restarted participant missed the rotation"
+        assert len(installed) == n_part
+        pub_blobs = {
+            v[1].public_keys((len(v[2]) - 1) // 3, v[2]).encode()
+            for v in installed.values()
+        }
+        assert len(pub_blobs) == 1, "rotated public key sets disagree"
+    finally:
+        sc.set_cycle_params(1000, 500)
+
+
+def test_attendance_persists_across_node_restart():
+    """Node-level attendance durability: counts recorded from block
+    multisigs survive a node rebuild on the same KV store (reference:
+    ValidatorAttendanceRepository)."""
+    import asyncio
+
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.core.node import Node
+
+    class _Rng:
+        def __init__(self, seed):
+            self._r = random.Random(seed)
+
+        def randbelow(self, n):
+            return self._r.randrange(n)
+
+    pub, privs = trusted_key_gen(4, 1, rng=_Rng(9))
+    kv = MemoryKV()
+
+    async def scenario():
+        node = Node(
+            index=0,
+            public_keys=pub,
+            private_keys=privs[0],
+            chain_id=CHAIN,
+            kv=kv,
+        )
+        # simulate two persisted blocks co-signed by validators 0 and 2
+        from lachain_tpu.core.types import MultiSig
+
+        g = node.block_manager.block_by_height(0)
+        for height in (1, 2):
+            blk = _fake_block(node, g, height, signers=(0, 2))
+            node._record_attendance(blk)
+        return node
+
+    def _fake_block(node, genesis, height, signers):
+        from lachain_tpu.core.types import Block, BlockHeader, MultiSig
+
+        header = BlockHeader(
+            index=height,
+            prev_block_hash=genesis.hash(),
+            merkle_root=b"\x00" * 32,
+            state_hash=b"\x00" * 32,
+            nonce=height,
+        )
+        return Block(
+            header=header,
+            tx_hashes=(),
+            multisig=MultiSig(tuple((i, b"\x00" * 65) for i in signers)),
+        )
+
+    node = asyncio.run(scenario())
+    cycle = 0
+    assert node.attendance.get(pub.ecdsa_pub_keys[0], cycle) == 2
+    assert node.attendance.get(pub.ecdsa_pub_keys[1], cycle) == 0
+    # rebuild the node on the same kv: counts must survive
+    node2 = Node(
+        index=0, public_keys=pub, private_keys=privs[0], chain_id=CHAIN,
+        kv=kv,
+    )
+    assert node2.attendance.get(pub.ecdsa_pub_keys[0], cycle) == 2
+    assert node2.attendance.get(pub.ecdsa_pub_keys[2], cycle) == 2
