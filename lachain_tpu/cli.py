@@ -13,6 +13,11 @@ composition):
       the autonomous era lifecycle.
   lachain-tpu height --config netdir/config0.json
       one-shot local status (height + validator set) without RPC.
+  lachain-tpu db shrink|rollback --config ...
+      offline store maintenance (prune checkpoints / restore a snapshot;
+      reference `db` verb + --RollBackTo, Application.cs:119-127).
+  lachain-tpu encrypt|decrypt --wallet ...
+      wallet re-keying / decrypted inspection (reference encrypt/decrypt).
 """
 from __future__ import annotations
 
@@ -255,6 +260,70 @@ def cmd_height(args) -> int:
     return 0
 
 
+def cmd_db(args) -> int:
+    """Offline database maintenance: shrink (prune old trie checkpoints)
+    and rollback (restore an older snapshot) — reference `lachain db` verbs
+    + --RollBackTo (Program.cs:25-39, Application.cs:119-127). The node
+    must be STOPPED: both operations mutate the store non-transactionally
+    with respect to concurrent commits (storage/shrink.py docstring)."""
+    from .core.config import NodeConfig
+    from .storage.kv import SqliteKV
+    from .storage.shrink import DbShrink
+    from .storage.state import StateManager
+
+    cfg = NodeConfig.load(args.config)
+    db_path = cfg.storage_path or (
+        os.path.splitext(args.config)[0] + ".db"
+    )
+    if not os.path.exists(db_path):
+        print(f"no database at {db_path}", file=sys.stderr)
+        return 1
+    kv = SqliteKV(db_path)
+    state = StateManager(kv)
+    if args.db_cmd == "shrink":
+        stats = DbShrink(state, kv).shrink(args.retain)
+        print(json.dumps(stats))
+    elif args.db_cmd == "rollback":
+        height = args.height
+        old = state.committed_height()
+        try:
+            state.rollback_to(height)
+        except KeyError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(
+            json.dumps({"rolledBackFrom": old, "height": height})
+        )
+    return 0
+
+
+def cmd_encrypt(args) -> int:
+    """Password-protect (or re-key) a wallet file in place
+    (reference `lachain encrypt`, Program.cs:25-39)."""
+    from .core.vault import PrivateWallet
+
+    old_pw = args.old_password or os.environ.get(
+        "LACHAIN_WALLET_PASSWORD", ""
+    )
+    wallet = PrivateWallet.load(args.wallet, old_pw)
+    wallet.set_password(args.password)
+    wallet.save(args.wallet)
+    print(json.dumps({"wallet": args.wallet, "encrypted": bool(args.password)}))
+    return 0
+
+
+def cmd_decrypt(args) -> int:
+    """Print a wallet's decrypted JSON to stdout (reference
+    `lachain decrypt`) — for operator inspection/backup; keys go to the
+    terminal, so use deliberately."""
+    from .core.vault import PrivateWallet
+
+    pw = args.password or os.environ.get("LACHAIN_WALLET_PASSWORD", "")
+    wallet = PrivateWallet.load(args.wallet, pw)
+    print(wallet.to_json())
+    return 0
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -294,6 +363,29 @@ def main(argv=None) -> int:
     ht = sub.add_parser("height", help="print local chain status")
     ht.add_argument("--config", required=True)
     ht.set_defaults(fn=cmd_height)
+
+    db = sub.add_parser("db", help="offline database maintenance")
+    dbsub = db.add_subparsers(dest="db_cmd", required=True)
+    sh = dbsub.add_parser("shrink", help="prune old trie checkpoints")
+    sh.add_argument("--config", required=True)
+    sh.add_argument("--retain", type=int, default=1000,
+                    help="checkpoint depth to keep below the tip")
+    sh.set_defaults(fn=cmd_db)
+    rb = dbsub.add_parser("rollback", help="restore an older snapshot")
+    rb.add_argument("--config", required=True)
+    rb.add_argument("--height", type=int, required=True)
+    rb.set_defaults(fn=cmd_db)
+
+    en = sub.add_parser("encrypt", help="password-protect a wallet file")
+    en.add_argument("--wallet", required=True)
+    en.add_argument("--password", required=True)
+    en.add_argument("--old-password", default=None)
+    en.set_defaults(fn=cmd_encrypt)
+
+    de = sub.add_parser("decrypt", help="print a wallet's decrypted JSON")
+    de.add_argument("--wallet", required=True)
+    de.add_argument("--password", default=None)
+    de.set_defaults(fn=cmd_decrypt)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -22,7 +22,8 @@ from ..crypto.hashes import keccak256
 from ..storage.kv import EntryPrefix, KVStore, prefixed
 from ..storage.state import Snapshot, StateManager, StateRoots
 from ..utils import metrics
-from ..utils.serialization import write_u64
+from ..utils import bloom
+from ..utils.serialization import write_u32, write_u64
 from .execution import TransactionExecuter, set_balance
 from .types import (
     Block,
@@ -141,6 +142,28 @@ class BlockManager:
                     stx.encode(),
                 )
             )
+        # per-block log bloom over emitting addresses: eth_getLogs and the
+        # filter machinery skip non-matching blocks without decoding events
+        # (reference: Misc/BloomFilter.cs)
+        bl = bloom.empty()
+        snap = self.state.new_snapshot(em.roots)
+        for stx in txs:
+            th = stx.hash()
+            i = 0
+            while True:
+                raw = snap.get("events", th + write_u32(i))
+                if raw is None:
+                    break
+                bloom.add(bl, raw[:20])
+                i += 1
+        puts.append(
+            (
+                prefixed(
+                    EntryPrefix.BLOCK_BLOOM, write_u64(block.header.index)
+                ),
+                bytes(bl),
+            )
+        )
         self._kv.write_batch(puts)
         self.state.commit(block.header.index, em.roots)
         for cb in list(self.on_block_persisted):
@@ -162,6 +185,11 @@ class BlockManager:
     def transaction_by_hash(self, h: bytes) -> Optional[SignedTransaction]:
         enc = self._kv.get(prefixed(EntryPrefix.TRANSACTION_BY_HASH, h))
         return SignedTransaction.decode(enc) if enc else None
+
+    def bloom_by_height(self, height: int) -> Optional[bytes]:
+        return self._kv.get(
+            prefixed(EntryPrefix.BLOCK_BLOOM, write_u64(height))
+        )
 
     def receipt_by_hash(self, h: bytes) -> Optional[bytes]:
         snap = self.state.new_snapshot()

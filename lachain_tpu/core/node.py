@@ -265,10 +265,16 @@ class Node:
 
     def _on_pool_txs(self, sender: bytes, txs: List[SignedTransaction]) -> None:
         # gossip batches arrive many-at-once: batch-recover senders, but
-        # ONLY for txs that pass the pool's cheap dedup/gas checks first —
-        # a re-gossiped duplicate batch must cost hash lookups, not ECDSA
-        # recoveries (DoS surface otherwise)
-        fresh = [stx for stx in txs if self.pool.precheck(stx)]
+        # ONLY for txs that pass the pool's cheap dedup/gas checks first,
+        # deduped within the batch itself — a batch repeating one tx (or a
+        # re-gossiped batch) must cost hash lookups, not ECDSA recoveries
+        seen = set()
+        fresh = []
+        for stx in txs:
+            h = stx.hash()
+            if h not in seen and self.pool.precheck(stx):
+                seen.add(h)
+                fresh.append(stx)
         warm_sender_caches(fresh, self.chain_id)
         for stx in fresh:
             self.pool.add(stx)

@@ -206,5 +206,10 @@ class TransactionPool:
             self._by_nonce.pop((sender, stx.tx.nonce), None)
         self._kv.delete(prefixed(EntryPrefix.POOL_TX, h))
 
+    def tx_hashes(self) -> set:
+        """Snapshot of pooled tx hashes (pending-tx filters)."""
+        with self._lock:
+            return set(self._txs)
+
     def get(self, h: bytes) -> Optional[SignedTransaction]:
         return self._txs.get(h)

@@ -94,6 +94,14 @@ class PrivateWallet:
             tpke_priv=pair[0], ts_share=pair[1], ecdsa_priv=self.ecdsa_priv
         )
 
+    def set_password(self, password: str) -> None:
+        """Re-key the wallet (operator `encrypt` verb)."""
+        self._password = password
+
+    def to_json(self) -> str:
+        """Decrypted payload as JSON (operator `decrypt` verb)."""
+        return json.dumps(self._payload(), indent=2)
+
     # -- persistence -------------------------------------------------------
 
     def _payload(self) -> dict:

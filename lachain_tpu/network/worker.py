@@ -8,8 +8,7 @@ flushed at ~4 Hz — but as an asyncio task instead of a thread.
 from __future__ import annotations
 
 import asyncio
-import heapq
-import itertools
+from collections import deque
 from typing import List, Optional
 
 from .hub import Hub, PeerAddress
@@ -17,6 +16,11 @@ from .wire import MessageBatch, MessageFactory, NetworkMessage, PRIORITY
 
 MAX_BATCH_BYTES = 64 * 1024
 FLUSH_INTERVAL = 0.25
+# bound on bytes a dead peer's queue may hold before low-priority traffic
+# is shed (reconnect storms must not OOM the node); consensus messages are
+# the highest priority so they shed last
+MAX_QUEUE_BYTES = 8 * 1024 * 1024
+BACKOFF_MAX = 8.0
 
 
 class ClientWorker:
@@ -34,11 +38,17 @@ class ClientWorker:
         self._hub = hub
         self._flush_interval = flush_interval
         self._max_batch_bytes = max_batch_bytes
-        self._heap: List = []
-        self._seq = itertools.count()
+        # one FIFO deque per priority level (PRIORITY values are a small
+        # fixed set): O(1) enqueue, O(1) priority-ordered drain, O(1) shed
+        # from the least-important tail — a heap paid O(n) scans per
+        # message once a dead peer's queue hit the cap
+        self._queues = {p: deque() for p in sorted(set(PRIORITY.values()))}
         self._wakeup = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
+        self._queued_bytes = 0
+        self._backoff = flush_interval
+        self.consecutive_failures = 0
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -49,22 +59,40 @@ class ClientWorker:
         if self._task is not None:
             await self._task
 
+    def _pending(self) -> bool:
+        return any(self._queues.values())
+
     def enqueue(self, msg: NetworkMessage) -> None:
-        heapq.heappush(
-            self._heap, (PRIORITY[msg.kind], next(self._seq), msg)
-        )
+        self._queues[PRIORITY[msg.kind]].append(msg)
+        self._queued_bytes += len(msg.body) + 6
+        # shed the least-important traffic (numerically largest priority,
+        # newest first) when a dead peer's queue passes the cap; consensus
+        # outlives pool gossip
+        while self._queued_bytes > MAX_QUEUE_BYTES:
+            victim = None
+            for p in sorted(self._queues, reverse=True):
+                if self._queues[p]:
+                    victim = self._queues[p].pop()
+                    break
+            if victim is None:
+                break
+            self._queued_bytes -= len(victim.body) + 6
         # wake immediately once a batch's worth is pending
-        pending = sum(len(m.body) + 6 for _, _, m in self._heap)
-        if pending >= self._max_batch_bytes:
+        if self._queued_bytes >= self._max_batch_bytes:
             self._wakeup.set()
 
     def _drain_batch(self) -> List[NetworkMessage]:
         out: List[NetworkMessage] = []
         size = 0
-        while self._heap and size < self._max_batch_bytes:
-            _, _, msg = heapq.heappop(self._heap)
-            out.append(msg)
-            size += len(msg.body) + 6
+        for p in sorted(self._queues):
+            q = self._queues[p]
+            while q and size < self._max_batch_bytes:
+                msg = q.popleft()
+                out.append(msg)
+                size += len(msg.body) + 6
+            if size >= self._max_batch_bytes:
+                break
+        self._queued_bytes = max(0, self._queued_bytes - size)
         return out
 
     async def _run(self) -> None:
@@ -76,21 +104,28 @@ class ClientWorker:
             except asyncio.TimeoutError:
                 pass
             self._wakeup.clear()
-            while self._heap:
+            while self._pending():
                 msgs = self._drain_batch()
                 batch: MessageBatch = self._factory.batch(msgs)
                 ok = await self._hub.send_raw(self.peer, batch.encode())
-                if not ok:
-                    # peer unreachable: requeue and back off; consensus
-                    # retransmission is handled at the protocol layer
-                    for m in msgs:
-                        heapq.heappush(
-                            self._heap,
-                            (PRIORITY[m.kind], next(self._seq), m),
-                        )
-                    await asyncio.sleep(self._flush_interval)
+                if ok:
+                    self._backoff = self._flush_interval
+                    self.consecutive_failures = 0
+                else:
+                    # peer unreachable: requeue and back off EXPONENTIALLY
+                    # (a down peer must not be re-dialed 4x/s forever);
+                    # every send_raw re-dials, so recovery is the first
+                    # successful dial after the peer returns
+                    self.consecutive_failures += 1
+                    for m in reversed(msgs):
+                        # requeue at the FRONT of each priority queue so
+                        # ordering within a priority is preserved
+                        self._queues[PRIORITY[m.kind]].appendleft(m)
+                        self._queued_bytes += len(m.body) + 6
+                    await asyncio.sleep(self._backoff)
+                    self._backoff = min(self._backoff * 2, BACKOFF_MAX)
                     break
         # final flush on stop
-        if self._heap:
+        if self._pending():
             msgs = self._drain_batch()
             await self._hub.send_raw(self.peer, self._factory.batch(msgs).encode())

@@ -50,6 +50,9 @@ class RpcService:
 
     def __init__(self, node):
         self.node = node
+        # poll-based filter registry (eth_newFilter family)
+        self._filters: Dict[str, dict] = {}
+        self._filter_seq = 0
 
     # -- helpers ------------------------------------------------------------
 
@@ -84,6 +87,10 @@ class RpcService:
             "nonce": _hex(h.nonce),
             "transactions": txs,
             "signatureCount": len(block.multisig.signatures),
+            "logsBloom": _h(
+                self.node.block_manager.bloom_by_height(h.index)
+                or b"\x00" * 256
+            ),
         }
 
     def _tx_json(
@@ -240,28 +247,28 @@ class RpcService:
     def eth_accounts(self):
         return [_h(self.node.address20)]
 
-    def eth_getLogs(self, flt=None):
-        flt = flt or {}
+    def _tag_to_height(self, tag, default):
+        if tag in (None, "latest", "pending"):
+            return default
+        if tag == "earliest":
+            return 0
+        return _unhex(tag)
+
+    def _scan_logs(self, frm: int, to: int, want_addr) -> List[dict]:
+        """Log scan over [frm, to] consulting per-block blooms: a block
+        whose bloom cannot contain the wanted address is skipped without
+        decoding any events (reference: Misc/BloomFilter.cs consulted by
+        BlockchainServiceWeb3.GetLogs)."""
+        from ..utils import bloom as _bloom
+
         bm = self.node.block_manager
-
-        def tag_to_height(tag, default):
-            if tag in (None, "latest", "pending"):
-                return default
-            if tag == "earliest":
-                return 0
-            return _unhex(tag)
-
-        frm = tag_to_height(flt.get("fromBlock"), bm.current_height())
-        to = tag_to_height(flt.get("toBlock"), bm.current_height())
-        to = min(to, bm.current_height())
-        if to - frm > 1000:
-            raise JsonRpcError(-32005, "block range too wide (max 1000)")
-        want_addr = (
-            _bytes(flt["address"]) if flt.get("address") else None
-        )
         out = []
         snap = self._snap()  # one snapshot for the whole scan
         for height in range(frm, to + 1):
+            if want_addr is not None:
+                bl = bm.bloom_by_height(height)
+                if bl is not None and not _bloom.contains(bl, want_addr):
+                    continue
             block = bm.block_by_height(height)
             if block is None:
                 continue
@@ -273,6 +280,122 @@ class RpcService:
                     or _bytes(log["address"]) == want_addr
                 )
         return out
+
+    def eth_getLogs(self, flt=None):
+        flt = flt or {}
+        bm = self.node.block_manager
+        frm = self._tag_to_height(flt.get("fromBlock"), bm.current_height())
+        to = self._tag_to_height(flt.get("toBlock"), bm.current_height())
+        to = min(to, bm.current_height())
+        want_addr = (
+            _bytes(flt["address"]) if flt.get("address") else None
+        )
+        # blooms make wide address-filtered scans cheap; unfiltered scans
+        # stay capped (they decode every event in range regardless)
+        cap = 100_000 if want_addr is not None else 1000
+        if to - frm > cap:
+            raise JsonRpcError(
+                -32005, f"block range too wide (max {cap})"
+            )
+        return self._scan_logs(frm, to, want_addr)
+
+    # -- filter objects (reference: BlockchainFilter/
+    #    BlockchainEventFilter.cs:1-254 — poll-based filter lifecycle) ------
+
+    _MAX_FILTERS = 256
+
+    def _new_filter_id(self, kind: str, state: dict) -> str:
+        if len(self._filters) >= self._MAX_FILTERS:
+            # drop the oldest (reference caps and expires filters)
+            self._filters.pop(next(iter(self._filters)))
+        self._filter_seq += 1
+        fid = _hex(self._filter_seq)
+        state["kind"] = kind
+        self._filters[fid] = state
+        return fid
+
+    def eth_newFilter(self, flt=None):
+        flt = flt or {}
+        bm = self.node.block_manager
+        return self._new_filter_id(
+            "log",
+            {
+                "from": self._tag_to_height(
+                    flt.get("fromBlock"), bm.current_height() + 1
+                ),
+                "to_tag": flt.get("toBlock"),
+                "address": flt.get("address"),
+                "delivered": bm.current_height(),
+            },
+        )
+
+    def eth_newBlockFilter(self):
+        return self._new_filter_id(
+            "block",
+            {"delivered": self.node.block_manager.current_height()},
+        )
+
+    def eth_newPendingTransactionFilter(self):
+        return self._new_filter_id(
+            "pending", {"seen": self.node.pool.tx_hashes()}
+        )
+
+    def eth_uninstallFilter(self, fid):
+        return self._filters.pop(fid, None) is not None
+
+    def eth_getFilterChanges(self, fid):
+        st = self._filters.get(fid)
+        if st is None:
+            raise JsonRpcError(-32000, "filter not found")
+        bm = self.node.block_manager
+        cur = bm.current_height()
+        if st["kind"] == "block":
+            out = []
+            to = min(cur, st["delivered"] + 10_000)  # bounded per poll
+            for height in range(st["delivered"] + 1, to + 1):
+                block = bm.block_by_height(height)
+                if block is not None:
+                    out.append(_h(block.hash()))
+            st["delivered"] = to
+            return out
+        if st["kind"] == "pending":
+            now = self.node.pool.tx_hashes()
+            fresh = now - st["seen"]
+            st["seen"] = now
+            return [_h(h) for h in sorted(fresh)]
+        # log filter: new logs since the last poll, within its range;
+        # each poll scans a BOUNDED window (same caps as eth_getLogs) and
+        # `delivered` advances only as far as actually scanned, so a long
+        # poll gap resumes across calls instead of pinning the event loop
+        want_addr = (
+            _bytes(st["address"]) if st.get("address") else None
+        )
+        cap = 100_000 if want_addr is not None else 1000
+        to = min(self._tag_to_height(st.get("to_tag"), cur), cur)
+        frm = max(st["from"], st["delivered"] + 1)
+        if frm > to:
+            return []
+        to = min(to, frm + cap - 1)
+        st["delivered"] = to
+        return self._scan_logs(frm, to, want_addr)
+
+    def eth_getFilterLogs(self, fid):
+        st = self._filters.get(fid)
+        if st is None or st["kind"] != "log":
+            raise JsonRpcError(-32000, "filter not found")
+        bm = self.node.block_manager
+        cur = bm.current_height()
+        to = min(self._tag_to_height(st.get("to_tag"), cur), cur)
+        frm = min(st["from"], cur)
+        want_addr = (
+            _bytes(st["address"]) if st.get("address") else None
+        )
+        cap = 100_000 if want_addr is not None else 1000
+        if to - frm > cap:
+            raise JsonRpcError(
+                -32005, f"block range too wide (max {cap})"
+            )
+        return self._scan_logs(frm, to, want_addr)
 
     def _logs_for_tx(self, tx_hash: bytes, block=None, snap=None) -> List[dict]:
         snap = snap if snap is not None else self._snap()
@@ -296,6 +419,40 @@ class RpcService:
             i += 1
         return out
 
+    def eth_getBlockTransactionCountByNumber(self, tag):
+        block = self._resolve_block(tag)
+        return _hex(len(block.tx_hashes)) if block else None
+
+    def eth_getBlockTransactionCountByHash(self, block_hash):
+        block = self.node.block_manager.block_by_hash(_bytes(block_hash))
+        return _hex(len(block.tx_hashes)) if block else None
+
+    def _tx_at(self, block, index: int):
+        if block is None or not (0 <= index < len(block.tx_hashes)):
+            return None
+        stx = self.node.block_manager.transaction_by_hash(
+            block.tx_hashes[index]
+        )
+        return self._tx_json(stx, block, index) if stx else None
+
+    def eth_getTransactionByBlockNumberAndIndex(self, tag, index):
+        return self._tx_at(self._resolve_block(tag), _unhex(index))
+
+    def eth_getTransactionByBlockHashAndIndex(self, block_hash, index):
+        return self._tx_at(
+            self.node.block_manager.block_by_hash(_bytes(block_hash)),
+            _unhex(index),
+        )
+
+    def eth_protocolVersion(self):
+        return _hex(1)
+
+    def eth_getUncleCountByBlockNumber(self, tag):
+        return _hex(0)  # HoneyBadgerBFT has instant finality: no uncles
+
+    def eth_getUncleCountByBlockHash(self, block_hash):
+        return _hex(0)
+
     # -- net_* / web3_* ------------------------------------------------------
 
     def net_version(self):
@@ -304,8 +461,16 @@ class RpcService:
     def net_peerCount(self):
         return _hex(len(self.node.synchronizer.peer_heights))
 
+    def net_listening(self):
+        return True
+
     def web3_clientVersion(self):
-        return "lachain-tpu/0.2"
+        return "lachain-tpu/0.3"
+
+    def web3_sha3(self, data):
+        from ..crypto.hashes import keccak256
+
+        return _h(keccak256(_bytes(data)))
 
     # -- la_* / validator_* --------------------------------------------------
 
@@ -342,6 +507,34 @@ class RpcService:
             "stake": _hex(stake),
             "isValidator": in_set,
             "publicKey": _h(pub) if pub else None,
+        }
+
+    def la_attendance(self, cycle=None):
+        """Per-cycle signed-header attendance counts (the durable tracking
+        behind the staking contract's attendance-detection phase;
+        reference: ValidatorAttendance + ValidatorServiceWeb3)."""
+        att = self.node.attendance
+        c = _unhex(cycle) if cycle is not None else att.next_cycle
+        return {
+            "cycle": _hex(c),
+            "counts": {
+                _h(pk): att.get(pk, c)
+                for pk in self.node.public_keys.ecdsa_pub_keys
+            },
+        }
+
+    def la_poolStats(self):
+        return {
+            "pending": len(self.node.pool),
+            "minGasPrice": _hex(self.node.pool.min_gas_price),
+        }
+
+    def la_peers(self):
+        return {
+            "peerHeights": {
+                _h(pk): h
+                for pk, h in self.node.synchronizer.peer_heights.items()
+            },
         }
 
     def la_metrics(self):

@@ -26,6 +26,7 @@ class EntryPrefix(enum.IntEnum):
     BLOCK_BY_HASH = 0x0101
     BLOCK_HASH_BY_HEIGHT = 0x0102
     BLOCK_HEIGHT = 0x0103
+    BLOCK_BLOOM = 0x0104
     TRANSACTION_BY_HASH = 0x0201
     TRIE_NODE = 0x0301
     SNAPSHOT_INDEX = 0x0401

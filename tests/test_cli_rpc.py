@@ -145,3 +145,115 @@ def test_four_process_devnet_with_rpc(tmp_path):
                 p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_operator_verbs(tmp_path):
+    """db shrink / db rollback / encrypt / decrypt (reference
+    Program.cs:25-39 verbs + --RollBackTo, Application.cs:119-127)."""
+    import json as _json
+
+    from lachain_tpu.cli import main
+    from lachain_tpu.core.vault import PrivateWallet
+    from lachain_tpu.crypto import ecdsa as _ec
+
+    # wallet encrypt -> decrypt roundtrip
+    wpath = str(tmp_path / "w.wallet")
+    w = PrivateWallet(ecdsa_priv=_ec.generate_private_key(), path=wpath)
+    w.save()
+    assert main(["encrypt", "--wallet", wpath, "--password", "pw1"]) == 0
+    # old password no longer works
+    try:
+        PrivateWallet.load(wpath, "")
+        raised = False
+    except Exception:
+        raised = True
+    assert raised
+    import io
+    import sys as _sys
+
+    buf = io.StringIO()
+    old = _sys.stdout
+    _sys.stdout = buf
+    try:
+        assert main(
+            ["decrypt", "--wallet", wpath, "--password", "pw1"]
+        ) == 0
+    finally:
+        _sys.stdout = old
+    assert "ecdsa" in _json.loads(buf.getvalue())
+
+    # db verbs against a config + sqlite store with a couple of blocks
+    import asyncio
+
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.core.node import Node
+    from lachain_tpu.core.types import BlockHeader, MultiSig, tx_merkle_root
+    from lachain_tpu.storage.kv import SqliteKV
+
+    class Rng:
+        def __init__(self, seed):
+            import random as _r
+
+            self._r = _r.Random(seed)
+
+        def randbelow(self, n):
+            return self._r.randrange(n)
+
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(2))
+    db_path = str(tmp_path / "node.db")
+
+    async def build():
+        kv = SqliteKV(db_path)
+        node = Node(
+            index=0, public_keys=pub, private_keys=privs[0], chain_id=99,
+            kv=kv,
+        )
+        bm = node.block_manager
+        for height in (1, 2, 3):
+            em = bm.emulate([], height)
+            prev = bm.block_by_height(height - 1)
+            header = BlockHeader(
+                index=height, prev_block_hash=prev.hash(),
+                merkle_root=tx_merkle_root([]), state_hash=em.state_hash,
+                nonce=height,
+            )
+            bm.execute_block(header, [], MultiSig(()))
+        kv.close()
+
+    asyncio.run(build())
+    cfg_path = str(tmp_path / "node.json")
+    with open(cfg_path, "w") as f:
+        _json.dump(
+            {
+                "version": 3,
+                "chainId": 99,
+                "storagePath": db_path,
+                "genesis": {
+                    "consensusKeys": pub.encode().hex(),
+                    "validatorIndex": -1,
+                    "balances": {},
+                },
+                "network": {"host": "127.0.0.1", "port": 0, "peers": []},
+                "vault": {"path": wpath, "password": "pw1"},
+            },
+            f,
+        )
+    buf = io.StringIO()
+    _sys.stdout = buf
+    try:
+        assert main(
+            ["db", "rollback", "--config", cfg_path, "--height", "2"]
+        ) == 0
+        assert main(
+            ["db", "shrink", "--config", cfg_path, "--retain", "1"]
+        ) == 0
+    finally:
+        _sys.stdout = old
+    lines = buf.getvalue().strip().splitlines()
+    assert _json.loads(lines[0])["height"] == 2
+    assert "swept" in _json.loads(lines[1])
+    # the store reflects the rollback
+    kv = SqliteKV(db_path)
+    from lachain_tpu.storage.state import StateManager
+
+    assert StateManager(kv).committed_height() == 2

@@ -278,3 +278,70 @@ def test_zip_bomb_batch_rejected():
     assert batch.verify()
     with pytest.raises(ValueError):
         batch.messages()
+
+
+@pytest.mark.slow
+def test_consensus_survives_severed_connections():
+    """VERDICT r2 #6 acceptance: sever every TCP connection of one
+    validator mid-era; consensus must complete after the transport
+    reconnects (reference hub redial behavior, Hub/HubConnector.cs:26-105).
+    Send-side sockets redial on demand with exponential backoff; the
+    severed node's inbound server keeps accepting."""
+    import asyncio
+    import random as _random
+
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.core.node import Node
+
+    class Rng:
+        def __init__(self, seed):
+            self._r = _random.Random(seed)
+
+        def randbelow(self, n):
+            return self._r.randrange(n)
+
+    async def run():
+        n, f = 4, 1
+        pub, privs = trusted_key_gen(n, f, rng=Rng(21))
+        nodes = [
+            Node(
+                index=i,
+                public_keys=pub,
+                private_keys=privs[i],
+                chain_id=515,
+                flush_interval=0.01,
+            )
+            for i in range(n)
+        ]
+        for node in nodes:
+            await node.start()
+        addrs = [node.address for node in nodes]
+        for node in nodes:
+            node.connect(addrs)
+        tasks = [
+            asyncio.ensure_future(node.run(first_era=1, stop_at=4))
+            for node in nodes
+        ]
+        # let era 1 get going, then sever node 0's sockets in both
+        # directions (outbound cached writers + everyone's writer TO it)
+        await asyncio.sleep(0.4)
+        victim = nodes[0]
+        for w in list(victim.network.hub._conns.values()):
+            w.close()
+        victim.network.hub._conns.clear()
+        for other in nodes[1:]:
+            for w in list(other.network.hub._conns.values()):
+                w.close()
+            other.network.hub._conns.clear()
+        done, pending = await asyncio.wait(tasks, timeout=120)
+        for t in pending:
+            t.cancel()
+        assert not pending, "consensus did not recover after sever"
+        for t in done:
+            t.result()
+        heights = [nd.block_manager.current_height() for nd in nodes]
+        assert all(h >= 4 for h in heights), heights
+        for node in nodes:
+            await node.stop()
+
+    asyncio.run(run())
