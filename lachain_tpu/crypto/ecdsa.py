@@ -284,6 +284,40 @@ def recover_hash(msg_hash: bytes, sig: bytes) -> Optional[bytes]:
     return _recover_hash_py(msg_hash, sig)
 
 
+# batches at least this large route to the TPU recover kernel when a chip
+# is present (ops/psecp.py: per-lane windowed scalar muls on the MXU);
+# smaller batches stay on the native threaded path
+import os as _os_mod
+
+_TPU_RECOVER_MIN = int(_os_mod.environ.get("LTPU_TPU_ECDSA_MIN", "2048"))
+_tpu_recover_cache = [False, None]
+
+
+def _tpu_recover(hashes, sigs):
+    """TPU batch recovery, or None to fall through to the native path."""
+    if not _tpu_recover_cache[0]:
+        _tpu_recover_cache[0] = True
+        try:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from ..ops.psecp import TpuEcdsaRecover
+
+                _tpu_recover_cache[1] = TpuEcdsaRecover()
+        except Exception:
+            _tpu_recover_cache[1] = None
+    rec = _tpu_recover_cache[1]
+    if rec is None:
+        return None
+    try:
+        out = rec.recover_batch(list(hashes), list(sigs))
+        metrics.inc("crypto_tpu_ecdsa_recover_batches")
+        return out
+    except Exception:
+        metrics.inc("crypto_tpu_ecdsa_recover_fallbacks")
+        return None
+
+
 @metrics.timed("crypto_ec_recover_batch")
 def recover_hash_batch(
     hashes: Sequence[bytes],
@@ -311,6 +345,20 @@ def recover_hash_batch(
     out: List[Optional[bytes]] = [None] * n
     if lib is None or not regular:
         return [recover_hash(h, s) for h, s in zip(hashes, sigs)]
+    if len(regular) >= _TPU_RECOVER_MIN:
+        tpu_out = _tpu_recover(
+            [hashes[i] for i in regular], [sigs[i] for i in regular]
+        )
+        if tpu_out is not None:
+            for pos, i in enumerate(regular):
+                out[i] = tpu_out[pos]
+            # irregular entries keep the scalar path (same contract as the
+            # native route below): identical results with or without a chip
+            regular_set = set(regular)
+            for i in range(n):
+                if i not in regular_set:
+                    out[i] = recover_hash(hashes[i], sigs[i])
+            return out
     import ctypes as _ct
 
     hb = b"".join(hashes[i] for i in regular)
