@@ -142,6 +142,23 @@ class BlockManager:
                     stx.encode(),
                 )
             )
+        # address -> tx index (sender and recipient): serves the fe_*
+        # account-history RPC family (reference FrontEndService.cs) without
+        # chain scans. Key: prefix | address | height | index-in-block.
+        for i, stx in enumerate(txs):
+            th = stx.hash()
+            key_tail = write_u64(block.header.index) + write_u32(i)
+            touched = {stx.tx.to}
+            sender = stx.sender(self.executer.chain_id)
+            if sender is not None:
+                touched.add(sender)
+            for addr in touched:
+                puts.append(
+                    (
+                        prefixed(EntryPrefix.ADDRESS_TX, addr + key_tail),
+                        th,
+                    )
+                )
         # per-block log bloom over emitting addresses: eth_getLogs and the
         # filter machinery skip non-matching blocks without decoding events
         # (reference: Misc/BloomFilter.cs)
@@ -185,6 +202,22 @@ class BlockManager:
     def transaction_by_hash(self, h: bytes) -> Optional[SignedTransaction]:
         enc = self._kv.get(prefixed(EntryPrefix.TRANSACTION_BY_HASH, h))
         return SignedTransaction.decode(enc) if enc else None
+
+    def transactions_by_address(
+        self, addr: bytes, limit: int = 100, before_height: Optional[int] = None
+    ) -> list:
+        """Most-recent-first tx hashes touching `addr` (sender or
+        recipient), paginated by height. Requires the KV store to support
+        prefix scans (both backends do)."""
+        prefix = prefixed(EntryPrefix.ADDRESS_TX, addr)
+        out = []
+        for key, th in self._kv.scan_prefix(prefix):
+            height = int.from_bytes(key[len(prefix) : len(prefix) + 8], "big")
+            if before_height is not None and height >= before_height:
+                continue
+            out.append((height, th))
+        out.sort(reverse=True)
+        return [(h, th) for h, th in out[:limit]]
 
     def bloom_by_height(self, height: int) -> Optional[bytes]:
         return self._kv.get(

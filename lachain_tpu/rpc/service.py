@@ -554,11 +554,60 @@ class RpcService:
             "withdrawRequested": vsm.withdraw_requested,
         }
 
+    # -- fe_* frontend services (reference: FrontEndService.cs:1-459) --------
+
+    def fe_getBalance(self, address):
+        """Balance + pool state for a wallet frontend in one call."""
+        addr = _bytes(address)
+        snap = self._snap()
+        return {
+            "address": address,
+            "balance": _hex(execution.get_balance(snap, addr)),
+            "nonce": _hex(execution.get_nonce(snap, addr)),
+            "pendingNonce": _hex(self.node.pool.next_nonce(addr)),
+        }
+
+    def fe_getTransactionsByAddress(self, address, limit="0x32", before=None):
+        """Most-recent-first transactions touching an address (sender or
+        recipient), served from the persist-time address index — no chain
+        scan."""
+        addr = _bytes(address)
+        n = min(_unhex(limit), 1000)
+        before_h = _unhex(before) if before is not None else None
+        bm = self.node.block_manager
+        out = []
+        for height, th in bm.transactions_by_address(
+            addr, limit=n, before_height=before_h
+        ):
+            stx = bm.transaction_by_hash(th)
+            if stx is None:
+                continue
+            block = bm.block_by_height(height)
+            idx = (
+                block.tx_hashes.index(th)
+                if block and th in block.tx_hashes
+                else 0
+            )
+            out.append(self._tx_json(stx, block, idx))
+        return out
+
+    def fe_getTransactionCountByAddress(self, address):
+        addr = _bytes(address)
+        return _hex(
+            len(
+                self.node.block_manager.transactions_by_address(
+                    addr, limit=1_000_000
+                )
+            )
+        )
+
     # -- registry ------------------------------------------------------------
 
     def methods(self) -> Dict[str, Any]:
         out = {}
         for name in dir(self):
-            if name.startswith(("eth_", "net_", "web3_", "la_", "validator_")):
+            if name.startswith(
+                ("eth_", "net_", "web3_", "la_", "validator_", "fe_")
+            ):
                 out[name] = getattr(self, name)
         return out

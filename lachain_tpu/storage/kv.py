@@ -28,6 +28,7 @@ class EntryPrefix(enum.IntEnum):
     BLOCK_HEIGHT = 0x0103
     BLOCK_BLOOM = 0x0104
     TRANSACTION_BY_HASH = 0x0201
+    ADDRESS_TX = 0x0202
     TRIE_NODE = 0x0301
     SNAPSHOT_INDEX = 0x0401
     POOL_TX = 0x0501

@@ -193,3 +193,30 @@ def test_breadth_methods(chain):
     assert svc.la_poolStats()["pending"] == 0
     att = svc.la_attendance()
     assert "counts" in att
+
+
+def test_fe_address_history(chain):
+    """fe_* frontend family (reference FrontEndService.cs): balance +
+    nonce in one call, and address-indexed tx history served from the
+    persist-time index rather than a chain scan."""
+    node, user, uaddr, produce = chain
+    svc = RpcService(node)
+    produce([_transfer_tx(user, 0)])
+    produce([_transfer_tx(user, 1)])
+    produce([])
+    ua = "0x" + uaddr.hex()
+    bal = svc.fe_getBalance(ua)
+    assert bal["nonce"] == "0x2"
+    txs = svc.fe_getTransactionsByAddress(ua)
+    assert len(txs) == 2
+    # most-recent first
+    assert txs[0]["blockNumber"] == "0x2" and txs[1]["blockNumber"] == "0x1"
+    assert svc.fe_getTransactionCountByAddress(ua) == "0x2"
+    # recipient side is indexed too
+    ta = "0x" + sc.NATIVE_TOKEN_ADDRESS.hex()
+    assert len(svc.fe_getTransactionsByAddress(ta)) == 2
+    # pagination
+    page = svc.fe_getTransactionsByAddress(ua, limit="0x1")
+    assert len(page) == 1 and page[0]["blockNumber"] == "0x2"
+    older = svc.fe_getTransactionsByAddress(ua, before="0x2")
+    assert len(older) == 1 and older[0]["blockNumber"] == "0x1"
