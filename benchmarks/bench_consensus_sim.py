@@ -34,6 +34,12 @@ def main() -> None:
     ap.add_argument("--txs", type=int, default=1000)
     ap.add_argument("--eras", type=int, default=2)
     ap.add_argument("--max-messages", type=int, default=20_000_000)
+    ap.add_argument(
+        "--engine",
+        default="native",
+        choices=["native", "python"],
+        help="consensus runtime: native C++ engine or the Python simulator",
+    )
     args = ap.parse_args()
 
     from lachain_tpu.core.devnet import Devnet
@@ -42,7 +48,10 @@ def main() -> None:
 
     n = args.n
     f = (n - 1) // 3
-    users = [ecdsa.generate_private_key(Rng(5 + i)) for i in range(16)]
+    # enough distinct senders that n validators' random proposals can union
+    # to a full block (per-sender nonce chains cap how much of one sender's
+    # traffic a single block can carry)
+    users = [ecdsa.generate_private_key(Rng(5 + i)) for i in range(max(16, args.n * 4))]
     balances = {
         ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)): 10**24
         for u in users
@@ -53,6 +62,7 @@ def main() -> None:
         initial_balances=balances,
         seed=7,
         txs_per_block=args.txs,
+        engine=args.engine,
     )
 
     total_txs = 0
@@ -88,6 +98,7 @@ def main() -> None:
                 "unit": f"s/era @ N={n} simulated, {args.txs} tx submitted",
                 "n_validators": n,
                 "f": f,
+                "engine": args.engine,
                 "txs_per_era": total_txs // args.eras,
                 "tx_per_s": round(total_txs / sum(times), 1),
             }

@@ -31,6 +31,11 @@ class CommonCoin(Protocol):
         self._signer = ts.ThresholdSigner(pid.to_bytes(), key_share, pub_key_set)
         self._requested = False
         self._done = False
+        # raw share bytes per sender, parsed lazily: only once t+1 candidates
+        # exist does anyone pay the G2 parse — and then via ONE batched
+        # deserialize+subgroup check instead of a full-order mul per point
+        self._raw: dict = {}
+        self._parsed: set = set()
 
     def handle_input(self, value) -> None:
         if self._requested:
@@ -40,28 +45,53 @@ class CommonCoin(Protocol):
         self.broadcaster.broadcast(
             M.CoinMessage(coin=self.id, share=my_share.to_bytes())
         )
-        # my own share counts immediately
-        self._add(my_share)
+        # my own share counts immediately (no parse needed — it's ours)
+        self._raw[self.me] = my_share.to_bytes()
+        self._parsed.add(self.me)
+        self._signer.add_share(my_share, verify=False)
+        self._try_combine()
 
     def handle_external(self, sender: int, payload) -> None:
         if not isinstance(payload, M.CoinMessage):
             raise TypeError(f"unexpected payload {type(payload)}")
-        try:
-            share = ts.PartialSignature.from_bytes(payload.share)
-        except (ValueError, AssertionError):
-            return  # malformed share: drop (byzantine sender)
-        if share.signer_id != sender:
-            return  # equivocation attempt: share must be the sender's own
-        self._add(share)
+        if self._done or sender in self._raw:
+            return
+        from ..crypto import bls12381 as bls
 
-    def _add(self, share: ts.PartialSignature) -> None:
+        data = payload.share
+        # id/length checks straight off the wire; share must be the sender's
+        # own (equivocation check) — point parse deferred to combine time
+        if len(data) != bls.G2_BYTES + 4:
+            return
+        if int.from_bytes(data[bls.G2_BYTES :], "big") != sender:
+            return
+        self._raw[sender] = data
+        self._try_combine()
+
+    def _try_combine(self) -> None:
         if self._done:
             return
-        # deferred verification: shares are accepted unverified; the signer
-        # checks the COMBINED signature (2 pairings total) and only falls back
-        # to the RLC batch verifier to prune bad shares when that check fails
-        # — this is the batched path the module docstring promises.
-        self._signer.add_share(share, verify=False)
+        need = self._signer.pub_key_set.t + 1
+        if len(self._raw) < need:
+            return
+        pending = [s for s in sorted(self._raw) if s not in self._parsed]
+        if pending:
+            from ..crypto import bls12381 as bls
+            from ..crypto.provider import deserialize_batch_g2
+
+            pts = deserialize_batch_g2(
+                [self._raw[s][: bls.G2_BYTES] for s in pending]
+            )
+            for s, pt in zip(pending, pts):
+                self._parsed.add(s)
+                if pt is None:
+                    continue  # malformed/bad-subgroup share: drop
+                # deferred verification: the signer checks the COMBINED
+                # signature (2 pairings total) and only falls back to the
+                # RLC batch verifier to prune bad shares when that fails
+                self._signer.add_share(
+                    ts.PartialSignature(sigma=pt, signer_id=s), verify=False
+                )
         sig = self._signer.signature
         if sig is not None:
             self._done = True

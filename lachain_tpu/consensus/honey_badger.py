@@ -41,13 +41,21 @@ class HoneyBadger(Protocol):
         self._priv = private_keys
         self._skip_validation = skip_share_validation
         self._ciphertexts: Optional[Dict[int, tpke.EncryptedShare]] = None
-        # per-slot: decryptor -> share (candidates, unverified)
-        self._shares: Dict[int, Dict[int, tpke.PartiallyDecryptedShare]] = {}
+        # per-slot: decryptor -> RAW share bytes (candidates, unverified).
+        # Points are parsed lazily — only the t+1 shares actually chosen for
+        # a combination ever pay the G1 parse + subgroup check (the ingest
+        # path peeks the ids straight from the wire bytes)
+        self._shares: Dict[int, Dict[int, bytes]] = {}
+        self._parsed: Dict[Tuple[int, int], tpke.PartiallyDecryptedShare] = {}
         self._rejected: Dict[int, set] = {}
         self._plaintexts: Dict[int, Optional[bytes]] = {}
         # pre-ACS stash, deduped by (sender, slot) and bounded: a byzantine
         # validator may send at most one candidate per (sender, slot) pair
         self._stashed: Dict[Tuple[int, int], M.DecryptedMessage] = {}
+        # slots whose jobs sit in a router-level crypto batcher awaiting flush
+        self._inflight: set = set()
+        self._batcher_queued = False
+        self._lag_cache: Dict[Tuple[int, ...], list] = {}
         self._done = False
 
     # -- input ---------------------------------------------------------------
@@ -60,26 +68,39 @@ class HoneyBadger(Protocol):
         if not isinstance(child_id, M.CommonSubsetId) or self._ciphertexts is not None:
             return
         self._ciphertexts = {}
-        for slot, blob in value.items():
-            try:
-                share = tpke.EncryptedShare.from_bytes(blob)
-            except (ValueError, AssertionError):
+        parsed: Dict[int, tpke.EncryptedShare] = {}
+        in_slots = sorted(value)
+        decoded = tpke.decode_encrypted_shares_batch(
+            [value[s] for s in in_slots]
+        )
+        for slot, share in zip(in_slots, decoded):
+            if share is None:
                 # proposer shipped garbage through RBC: slot yields nothing
                 self._plaintexts[slot] = None
-                continue
-            self._ciphertexts[slot] = share
-            try:
-                dec = self._priv.tpke_priv.decrypt_share(share)
-            except ValueError:
+            else:
+                parsed[slot] = share
+        # ciphertext validity for ALL accepted slots in one RLC multi-pairing
+        # (2 pairings per slot in the reference, TPKE/PrivateKey.cs:21-27)
+        slots = sorted(parsed)
+        if self._skip_validation:
+            oks = [True] * len(slots)
+        else:
+            oks = tpke.batch_verify_ciphertexts([parsed[s] for s in slots])
+        for slot, ok in zip(slots, oks):
+            if not ok:
                 # invalid ciphertext (fails the pairing validity check)
                 self._plaintexts[slot] = None
                 continue
+            share = parsed[slot]
+            self._ciphertexts[slot] = share
+            dec = self._priv.tpke_priv.decrypt_share(share, check=False)
             self.broadcaster.broadcast(
                 M.DecryptedMessage(
                     hb=self.id, share_id=slot, payload=dec.to_bytes()
                 )
             )
-            self._shares.setdefault(slot, {})[self.me] = dec
+            self._shares.setdefault(slot, {})[self.me] = dec.to_bytes()
+            self._parsed[(slot, self.me)] = dec
         stashed, self._stashed = self._stashed, {}
         for (sender, _slot), msg in stashed.items():
             self._on_decrypted(sender, msg, defer_decrypt=True)
@@ -109,19 +130,30 @@ class HoneyBadger(Protocol):
             return  # unknown/rejected slot
         if slot in self._plaintexts:
             return  # already decrypted
-        try:
-            dec = tpke.PartiallyDecryptedShare.from_bytes(msg.payload)
-        except (ValueError, AssertionError):
-            return
-        # the share must claim the sender as decryptor (HoneyBadger.cs:196-217
-        # dedup/decryptor-id checks)
-        if dec.decryptor_id != sender or dec.share_id != slot:
+        # id checks straight off the wire bytes — the expensive point parse
+        # is deferred until this share is chosen for a combination
+        # (HoneyBadger.cs:196-217 dedup/decryptor-id checks)
+        ids = tpke.peek_decrypted_share_ids(msg.payload)
+        if ids is None or ids[0] != sender or ids[1] != slot:
             return
         slot_shares = self._shares.setdefault(slot, {})
         if sender in slot_shares or sender in self._rejected.get(slot, set()):
             return
-        slot_shares[sender] = dec
-        if not defer_decrypt:
+        slot_shares[sender] = msg.payload
+        if defer_decrypt:
+            return
+        batcher = getattr(self.broadcaster, "crypto_batcher", None)
+        if batcher is not None and not self._skip_validation:
+            # O(1) hot path: note once that ready work exists; the expensive
+            # per-slot preparation happens exactly once, at flush time
+            if (
+                not self._batcher_queued
+                and slot not in self._inflight
+                and len(slot_shares) >= self._pub.f + 1
+            ):
+                self._batcher_queued = True
+                batcher.submit_lazy(self._build_era_jobs_lazy)
+        else:
             self._try_decrypt_ready()
             self._try_complete()
 
@@ -132,6 +164,7 @@ class HoneyBadger(Protocol):
             s
             for s in (self._ciphertexts or {})
             if s not in self._plaintexts
+            and s not in self._inflight
             and len(self._shares.get(s, {})) >= need
         ]
 
@@ -141,27 +174,77 @@ class HoneyBadger(Protocol):
         (opportunistic micro-batching: whatever is pending runs NOW; with
         the host backends this degrades to the per-slot RLC batch path).
         """
-        ready = self._ready_slots()
-        if not ready:
-            return
         from ..crypto.provider import get_backend
 
         backend = get_backend()
         era_fn = getattr(backend, "tpke_era_verify_combine", None)
         if era_fn is None or self._skip_validation:
-            for slot in ready:
+            for slot in self._ready_slots():
                 self._try_decrypt(slot)
             return
+        batcher = getattr(self.broadcaster, "crypto_batcher", None)
+        if batcher is not None:
+            # router-level flush batcher: the delivery loop flushes at
+            # quiescence, fusing every validator's pending slots into ONE
+            # backend call (one kernel launch on the TPU backend)
+            if not self._batcher_queued and self._ready_slots():
+                self._batcher_queued = True
+                batcher.submit_lazy(self._build_era_jobs_lazy)
+            return
+        built = self._build_era_jobs()
+        if built is None:
+            return
+        jobs, vks, cb = built
+        try:
+            results = era_fn(jobs, vks)
+        except Exception:
+            # device path unavailable/broken (jax import, compile, OOM):
+            # consensus liveness beats acceleration — host per-slot path
+            from .protocol import logger as _plog
+
+            _plog.exception("tpu era decrypt failed; host fallback")
+            cb(None)
+            return
+        cb(results)
+
+    def _build_era_jobs_lazy(self):
+        """Batcher flush hook: build jobs for everything ready RIGHT NOW."""
+        self._batcher_queued = False
+        if self.terminated or self._done:
+            return None
+        return self._build_era_jobs()
+
+    def _build_era_jobs(self):
+        """Choose + lazily parse the combination shares for every ready slot
+        and return (jobs, verification_keys, callback), or None when nothing
+        is ready. A share failing the parse/subgroup check is dropped, its
+        sender rejected, and the slot's choice recomputed from the survivors
+        (the loop terminates: every retry removes at least one share)."""
         from ..crypto import bls12381 as bls
         from ..crypto.tpu_backend import EraSlotJob
 
         need = self._pub.f + 1
+        while True:
+            ready = self._ready_slots()
+            if not ready:
+                return None
+            chosen_by_slot = {
+                s: sorted(self._shares[s])[:need] for s in ready
+            }
+            wanted = [(s, i) for s in ready for i in chosen_by_slot[s]]
+            if self._parse_shares(wanted) == 0:
+                break
         jobs = []
         for slot in ready:
             ct = self._ciphertexts[slot]
-            slot_shares = self._shares[slot]
-            chosen = sorted(slot_shares)[:need]
-            cs = bls.fr_lagrange_coeffs([i + 1 for i in chosen], at=0)
+            chosen = chosen_by_slot[slot]
+            key = tuple(chosen)
+            cs = self._lag_cache.get(key)
+            if cs is None:
+                # most slots choose the same first-F+1 decryptor set, so the
+                # Lagrange coefficients memoize extremely well per era
+                cs = bls.fr_lagrange_coeffs([i + 1 for i in chosen], at=0)
+                self._lag_cache[key] = cs
             lag_row = [0] * self.n
             u_row = [None] * self.n
             # only the chosen F+1 lanes go live: they are exactly the
@@ -170,7 +253,7 @@ class HoneyBadger(Protocol):
             # and force the host fallback every era
             for i, c in zip(chosen, cs):
                 lag_row[i] = c
-                u_row[i] = slot_shares[i].ui
+                u_row[i] = self._parsed[(slot, i)].ui
             jobs.append(
                 EraSlotJob(
                     u_by_validator=u_row,
@@ -179,17 +262,32 @@ class HoneyBadger(Protocol):
                     w=ct.w,
                 )
             )
-        try:
-            results = era_fn(jobs, self._pub.tpke_verification_keys)
-        except Exception:
-            # device path unavailable/broken (jax import, compile, OOM):
-            # consensus liveness beats acceleration — host per-slot path
-            from .protocol import logger as _plog
+        self._inflight.update(ready)
+        return (
+            jobs,
+            self._pub.tpke_verification_keys,
+            lambda results, _ready=tuple(ready): self._era_results_cb(
+                _ready, results
+            ),
+        )
 
-            _plog.exception("tpu era decrypt failed; host fallback")
+    def _era_results_cb(self, ready, results) -> None:
+        """Batcher flush callback: results is None when the batch call
+        itself failed (host per-slot fallback), else per-job (ok, combined)."""
+        self._inflight.difference_update(ready)
+        if self.terminated or self._done:
+            return
+        if results is None:
             for slot in ready:
                 self._try_decrypt(slot)
-            return
+        else:
+            self._apply_era_results(ready, results)
+        # slots whose batch failed may have pruned a share but still hold
+        # (or later regain) a quorum: re-queue whatever remains ready
+        self._try_decrypt_ready()
+        self._try_complete()
+
+    def _apply_era_results(self, ready, results) -> None:
         for slot, (ok, combined) in zip(ready, results):
             if ok:
                 self._plaintexts[slot] = tpke.decrypt_with_combined(
@@ -201,6 +299,34 @@ class HoneyBadger(Protocol):
                 # surviving valid shares)
                 self._try_decrypt(slot)
 
+    def _parse_shares(self, wanted) -> int:
+        """Parse raw share bytes into `self._parsed` for the given
+        (slot, sender) pairs — one batched deserialize+subgroup check for
+        everything missing. Failing shares are dropped and their senders
+        rejected for that slot. Returns the number of failures."""
+        missing = [k for k in wanted if k not in self._parsed]
+        if not missing:
+            return 0
+        from ..crypto import bls12381 as bls
+        from ..crypto.provider import deserialize_batch_g1
+
+        datas = [
+            self._shares[slot][sender][: bls.G1_BYTES]
+            for slot, sender in missing
+        ]
+        pts = deserialize_batch_g1(datas)
+        failures = 0
+        for (slot, sender), pt in zip(missing, pts):
+            if pt is None:
+                failures += 1
+                del self._shares[slot][sender]
+                self._rejected.setdefault(slot, set()).add(sender)
+            else:
+                self._parsed[(slot, sender)] = tpke.PartiallyDecryptedShare(
+                    ui=pt, decryptor_id=sender, share_id=slot
+                )
+        return failures
+
     def _try_decrypt(self, slot: int) -> None:
         if slot in self._plaintexts or self._ciphertexts is None:
             return
@@ -208,9 +334,12 @@ class HoneyBadger(Protocol):
         slot_shares = self._shares.get(slot, {})
         if len(slot_shares) < need:
             return
+        self._parse_shares([(slot, i) for i in sorted(slot_shares)])
+        if len(slot_shares) < need:
+            return  # parse failures shrank the candidate set
         ct = self._ciphertexts[slot]
         decryptors = sorted(slot_shares)
-        decs = [slot_shares[i] for i in decryptors]
+        decs = [self._parsed[(slot, i)] for i in decryptors]
         if self._skip_validation:
             valid = decs
         else:

@@ -1,0 +1,1233 @@
+// Native consensus runtime: message router + flood-protocol state machines.
+//
+// Role: the reference runs one OS thread + one queue per protocol instance
+// (/root/reference/src/Lachain.Consensus/AbstractProtocol.cs:11-168) and a
+// central test DeliveryService (test/Lachain.ConsensusTest/DeliverySerivce.cs).
+// This engine is the TPU-native answer for the HOT 90% of consensus traffic:
+// BinaryBroadcast (BVAL/AUX/CONF), ReliableBroadcast (VAL/ECHO/READY, with
+// GF(2^8) Reed-Solomon + keccak Merkle commitments), BinaryAgreement and
+// CommonSubset run natively; every crypto-bearing protocol (CommonCoin,
+// HoneyBadger, RootProtocol) stays in Python and its messages transit this
+// engine as opaque payloads, so the Python classes remain the single source
+// of cryptographic truth.
+//
+// The logic mirrors the Python protocols statement-for-statement
+// (lachain_tpu/consensus/{binary_broadcast,binary_agreement,
+// reliable_broadcast,common_subset}.py) so that a TAKE_FIRST run is
+// bit-identical to the Python simulator — tests/test_native_rt.py asserts
+// exact block-hash equality between the two engines.
+//
+// Single-threaded by design: determinism (same seed -> same execution,
+// including adversarial reorderings) is the property the reference's
+// thread-based harness only approximates.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Keccak-256 (legacy 0x01 padding — Ethereum style, matches
+// lachain_tpu/crypto/hashes.py::_keccak256_py)
+// ---------------------------------------------------------------------------
+
+static const uint64_t KC_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
+};
+static const int KC_ROT[5][5] = {
+    {0, 36, 3, 41, 18},
+    {1, 44, 10, 45, 2},
+    {62, 6, 43, 15, 61},
+    {28, 55, 25, 21, 56},
+    {27, 20, 39, 8, 14},
+};
+
+static inline uint64_t rol64(uint64_t v, int s) {
+  return s == 0 ? v : (v << s) | (v >> (64 - s));
+}
+
+static void keccak_f(uint64_t a[5][5]) {
+  uint64_t b[5][5], c[5], d[5];
+  for (int rnd = 0; rnd < 24; rnd++) {
+    for (int x = 0; x < 5; x++)
+      c[x] = a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4];
+    for (int x = 0; x < 5; x++)
+      d[x] = c[(x + 4) % 5] ^ rol64(c[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++) a[x][y] ^= d[x];
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        b[y][(2 * x + 3 * y) % 5] = rol64(a[x][y], KC_ROT[x][y]);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        a[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y]);
+    a[0][0] ^= KC_RC[rnd];
+  }
+}
+
+static void keccak256(const uint8_t* in, size_t inlen, uint8_t out[32]) {
+  const size_t rate = 136;
+  uint64_t st[5][5];
+  std::memset(st, 0, sizeof(st));
+  // absorb full blocks, then the padded tail
+  size_t off = 0;
+  uint8_t block[136];
+  while (true) {
+    size_t take = inlen - off >= rate ? rate : inlen - off;
+    std::memcpy(block, in + off, take);
+    bool last = take < rate;
+    if (last) {
+      std::memset(block + take, 0, rate - take);
+      block[take] = 0x01;
+      block[rate - 1] |= 0x80;
+    }
+    for (size_t i = 0; i < rate / 8; i++) {
+      uint64_t lane;
+      std::memcpy(&lane, block + i * 8, 8);  // little-endian host assumed
+      st[i % 5][i / 5] ^= lane;
+    }
+    keccak_f(st);
+    off += take;
+    if (last) break;
+    if (off == inlen) {
+      // input length is an exact multiple of rate: one more padding-only block
+      std::memset(block, 0, rate);
+      block[0] = 0x01;
+      block[rate - 1] |= 0x80;
+      for (size_t i = 0; i < rate / 8; i++) {
+        uint64_t lane;
+        std::memcpy(&lane, block + i * 8, 8);
+        st[i % 5][i / 5] ^= lane;
+      }
+      keccak_f(st);
+      break;
+    }
+  }
+  for (int i = 0; i < 4; i++) std::memcpy(out + i * 8, &st[i % 5][i / 5], 8);
+}
+
+static std::string keccak_s(const std::string& s) {
+  uint8_t h[32];
+  keccak256(reinterpret_cast<const uint8_t*>(s.data()), s.size(), h);
+  return std::string(reinterpret_cast<char*>(h), 32);
+}
+
+// ---------------------------------------------------------------------------
+// Merkle tree (crypto/hashes.py::merkle_root/proof/verify — odd leaf promoted
+// unchanged, "" sentinel for missing sibling)
+// ---------------------------------------------------------------------------
+
+static std::string merkle_root(std::vector<std::string> level) {
+  if (level.empty()) return std::string();
+  while (level.size() > 1) {
+    std::vector<std::string> nxt;
+    for (size_t i = 0; i + 1 < level.size(); i += 2)
+      nxt.push_back(keccak_s(level[i] + level[i + 1]));
+    if (level.size() % 2) nxt.push_back(level.back());
+    level.swap(nxt);
+  }
+  return level[0];
+}
+
+static std::vector<std::string> merkle_proof(std::vector<std::string> level,
+                                             size_t index) {
+  std::vector<std::string> proof;
+  size_t idx = index;
+  while (level.size() > 1) {
+    std::vector<std::string> nxt;
+    for (size_t i = 0; i + 1 < level.size(); i += 2)
+      nxt.push_back(keccak_s(level[i] + level[i + 1]));
+    if (level.size() % 2) nxt.push_back(level.back());
+    size_t sib = idx ^ 1;
+    proof.push_back(sib < level.size() ? level[sib] : std::string());
+    idx /= 2;
+    level.swap(nxt);
+  }
+  return proof;
+}
+
+static bool merkle_verify(const std::string& leaf, size_t index,
+                          const std::vector<std::string>& proof,
+                          const std::string& root) {
+  std::string node = leaf;
+  size_t idx = index;
+  for (const auto& sib : proof) {
+    if (sib.empty()) {
+      // promoted unchanged
+    } else if (idx % 2 == 0) {
+      node = keccak_s(node + sib);
+    } else {
+      node = keccak_s(sib + node);
+    }
+    idx /= 2;
+  }
+  return node == root;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) Reed-Solomon, poly 0x11D — exact mirror of lachain_tpu/ops/rs.py
+// (Vandermonde evaluation at x = 1..n, 4-byte BE length prefix, first-k
+// reconstruction) so native and Python validators compute identical shards
+// and Merkle roots.
+// ---------------------------------------------------------------------------
+
+static uint8_t GF_EXP[512];
+static int GF_LOG[256];
+static uint8_t GF_MUL[256][256];
+
+static void gf_init() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    GF_EXP[i] = (uint8_t)x;
+    GF_LOG[x] = i;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11D;
+  }
+  for (int i = 255; i < 512; i++) GF_EXP[i] = GF_EXP[i - 255];
+  for (int a = 0; a < 256; a++)
+    for (int b = 0; b < 256; b++)
+      GF_MUL[a][b] =
+          (a == 0 || b == 0) ? 0 : GF_EXP[GF_LOG[a] + GF_LOG[b]];
+}
+
+static inline uint8_t gf_inv(uint8_t a) { return GF_EXP[255 - GF_LOG[a]]; }
+
+static std::vector<std::string> rs_encode(const std::string& data, int k,
+                                          int n) {
+  // 4-byte BE length prefix, zero-pad to k * shard_size (rs.py::encode)
+  std::string prefixed;
+  uint32_t len = (uint32_t)data.size();
+  prefixed.push_back((char)(len >> 24));
+  prefixed.push_back((char)(len >> 16));
+  prefixed.push_back((char)(len >> 8));
+  prefixed.push_back((char)len);
+  prefixed += data;
+  size_t shard_size = (prefixed.size() + k - 1) / k;
+  if (shard_size == 0) shard_size = 1;
+  prefixed.resize((size_t)k * shard_size, '\0');
+  std::vector<std::string> shards(n);
+  std::vector<uint8_t> acc(shard_size);
+  for (int xi = 1; xi <= n; xi++) {
+    std::fill(acc.begin(), acc.end(), 0);
+    const uint8_t* mulx = GF_MUL[xi];
+    for (int j = k - 1; j >= 0; j--) {
+      const uint8_t* coeff =
+          reinterpret_cast<const uint8_t*>(prefixed.data()) + (size_t)j * shard_size;
+      for (size_t b = 0; b < shard_size; b++)
+        acc[b] = mulx[acc[b]] ^ coeff[b];
+    }
+    shards[xi - 1].assign(reinterpret_cast<char*>(acc.data()), shard_size);
+  }
+  return shards;
+}
+
+// Gauss-Jordan inverse over GF(2^8); returns false if singular.
+static bool gf_mat_inv(std::vector<uint8_t>& a, std::vector<uint8_t>& inv,
+                       int k) {
+  inv.assign((size_t)k * k, 0);
+  for (int i = 0; i < k; i++) inv[(size_t)i * k + i] = 1;
+  for (int col = 0; col < k; col++) {
+    int piv = -1;
+    for (int r = col; r < k; r++)
+      if (a[(size_t)r * k + col]) { piv = r; break; }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int c = 0; c < k; c++) {
+        std::swap(a[(size_t)col * k + c], a[(size_t)piv * k + c]);
+        std::swap(inv[(size_t)col * k + c], inv[(size_t)piv * k + c]);
+      }
+    }
+    uint8_t pinv = gf_inv(a[(size_t)col * k + col]);
+    const uint8_t* mp = GF_MUL[pinv];
+    for (int c = 0; c < k; c++) {
+      a[(size_t)col * k + c] = mp[a[(size_t)col * k + c]];
+      inv[(size_t)col * k + c] = mp[inv[(size_t)col * k + c]];
+    }
+    for (int r = 0; r < k; r++) {
+      if (r == col) continue;
+      uint8_t fct = a[(size_t)r * k + col];
+      if (!fct) continue;
+      const uint8_t* mf = GF_MUL[fct];
+      for (int c = 0; c < k; c++) {
+        a[(size_t)r * k + c] ^= mf[a[(size_t)col * k + c]];
+        inv[(size_t)r * k + c] ^= mf[inv[(size_t)col * k + c]];
+      }
+    }
+  }
+  return true;
+}
+
+// shards: n entries, empty string == missing. Mirrors rs.py::decode.
+static bool rs_decode(const std::vector<std::string>& shards, int k,
+                      std::string& out) {
+  int n = (int)shards.size();
+  std::vector<int> have_idx;
+  for (int i = 0; i < n && (int)have_idx.size() < k; i++)
+    if (!shards[i].empty()) have_idx.push_back(i);
+  if ((int)have_idx.size() < k) return false;
+  size_t size = shards[have_idx[0]].size();
+  // Vandermonde rows [x^0 .. x^{k-1}] at x = idx+1
+  std::vector<uint8_t> mat((size_t)k * k);
+  for (int r = 0; r < k; r++) {
+    uint8_t x = (uint8_t)(have_idx[r] + 1), v = 1;
+    for (int c = 0; c < k; c++) {
+      mat[(size_t)r * k + c] = v;
+      v = GF_MUL[v][x];
+    }
+  }
+  std::vector<uint8_t> inv;
+  if (!gf_mat_inv(mat, inv, k)) return false;
+  std::string flat((size_t)k * size, '\0');
+  std::vector<uint8_t> acc(size);
+  for (int r = 0; r < k; r++) {
+    std::fill(acc.begin(), acc.end(), 0);
+    for (int c = 0; c < k; c++) {
+      uint8_t f = inv[(size_t)r * k + c];
+      if (!f) continue;
+      const uint8_t* mf = GF_MUL[f];
+      const uint8_t* src =
+          reinterpret_cast<const uint8_t*>(shards[have_idx[c]].data());
+      for (size_t b = 0; b < size; b++) acc[b] ^= mf[src[b]];
+    }
+    std::memcpy(&flat[(size_t)r * size], acc.data(), size);
+  }
+  if (flat.size() < 4) return false;
+  uint32_t length = ((uint32_t)(uint8_t)flat[0] << 24) |
+                    ((uint32_t)(uint8_t)flat[1] << 16) |
+                    ((uint32_t)(uint8_t)flat[2] << 8) | (uint32_t)(uint8_t)flat[3];
+  if (length > flat.size() - 4) return false;
+  out = flat.substr(4, length);
+  return true;
+}
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Messages + queue
+// ---------------------------------------------------------------------------
+
+enum MsgType : uint8_t {
+  MT_BVAL = 0,
+  MT_AUX = 1,
+  MT_CONF = 2,
+  MT_VAL = 3,
+  MT_ECHO = 4,
+  MT_READY = 5,
+  MT_OPAQUE = 6,
+};
+
+struct Msg {
+  int refs = 0;
+  uint8_t type = 0;
+  int32_t era = 0;
+  int32_t agreement = 0;   // BB/opaque: agreement; VAL/ECHO/READY: rbc slot
+  int32_t epoch = 0;       // BB/opaque epoch
+  uint8_t value = 0;       // BVAL/AUX: bool; CONF: 2-bit set
+  uint8_t opq_kind = 0;    // opaque payload kind (Python-defined)
+  int32_t shard_index = 0; // VAL/ECHO
+  std::string root;        // VAL/ECHO/READY: 32-byte merkle root
+  std::vector<std::string> branch;  // VAL/ECHO ("" = odd-promotion sentinel)
+  std::string data;        // VAL/ECHO shard bytes; opaque payload
+};
+
+static inline void msg_release(Msg* m) {
+  if (--m->refs <= 0) delete m;
+}
+
+struct Entry {
+  int32_t sender;
+  int32_t target;
+  Msg* m;
+};
+
+struct Bits {
+  uint64_t w[4] = {0, 0, 0, 0};
+  inline void set(int i) { w[i >> 6] |= 1ULL << (i & 63); }
+  inline bool test(int i) const { return (w[i >> 6] >> (i & 63)) & 1; }
+  inline int count() const {
+    return __builtin_popcountll(w[0]) + __builtin_popcountll(w[1]) +
+           __builtin_popcountll(w[2]) + __builtin_popcountll(w[3]);
+  }
+};
+
+// Callback signatures (implemented in Python via ctypes):
+//  opaque delivery, ACS result, coin request for a native BinaryAgreement.
+typedef void (*opaque_cb_t)(int32_t target, int32_t sender, int32_t era,
+                            int32_t kind, int32_t agreement, int32_t epoch,
+                            const uint8_t* data, size_t len);
+typedef void (*acs_cb_t)(int32_t target, int32_t era, int32_t nslots,
+                         const int32_t* slots, const uint8_t* const* datas,
+                         const size_t* lens);
+typedef void (*coinreq_cb_t)(int32_t target, int32_t era, int32_t agreement,
+                             int32_t epoch);
+
+struct Engine;
+
+static const int EXTRA_ROUNDS = 3;  // binary_agreement.py::EXTRA_ROUNDS
+
+// coin_schedule(epoch) for odd epochs: 0/1 deterministic, -1 = real coin
+// (binary_agreement.py; reference CoinToss.cs:3-33)
+static inline int coin_schedule(int epoch) {
+  int k = (epoch / 2) % 3;
+  return k == 0 ? 0 : (k == 1 ? 1 : -1);
+}
+
+// --- BinaryBroadcast (binary_broadcast.py; BinaryBroadcast.cs:111-239) -----
+struct BB {
+  Engine* E;
+  int vid, agreement, epoch;
+  Bits bval_recv[2];
+  uint8_t bval_sent = 0;   // bit v: BVAL(v) broadcast already
+  uint8_t bin_values = 0;  // bit v: v accepted at 2F+1
+  Bits aux_seen;
+  int aux_cnt[2] = {0, 0};
+  Bits conf_seen;
+  int conf_cnt[4] = {0, 0, 0, 0};  // indexed by 2-bit conf set
+  bool aux_bcast = false, conf_bcast = false;
+  bool done = false, parented = false, terminated = false;
+  uint8_t result = 0;
+
+  void on_request(int est);
+  void on_bval(int sender, int v);
+  void on_aux(int sender, int v);
+  void on_conf(int sender, uint8_t set);
+  void progress();
+  void bcast_small(uint8_t type, uint8_t value);
+  void emit();
+};
+
+// --- BinaryAgreement (binary_agreement.py; BinaryAgreement.cs:52-143) ------
+struct BA {
+  Engine* E;
+  int vid, agreement;
+  int epoch = 0;
+  int8_t est = -1;
+  bool started = false;
+  std::unordered_map<int, uint8_t> bin_values;  // even epoch -> 2-bit set
+  std::unordered_map<int, int8_t> coins;        // odd epoch -> coin
+  int8_t decided = -1;
+  int decide_epoch = -1;
+  std::unordered_set<int> req_bb, req_coin;
+  bool done = false, parented = false, terminated = false;
+  bool result = false;
+
+  void on_request(int est_in);
+  void on_bb_result(int ep, uint8_t set);
+  void on_coin_result(int ep, bool v);
+  void advance();
+  void finish_round(int coin);
+  void emit();
+};
+
+// --- ReliableBroadcast (reliable_broadcast.py; ReliableBroadcast.cs) -------
+struct RBC {
+  Engine* E;
+  int vid, slot;
+  bool echo_sent = false, ready_sent = false, delivered = false,
+       val_seen = false;
+  bool done = false, parented = false, terminated = false;
+  struct PerRoot {
+    std::vector<std::string> shards;  // n entries, empty = missing
+    int have = 0;
+    Bits ready;
+  };
+  std::unordered_map<std::string, PerRoot> roots;
+  std::vector<std::pair<std::string, std::string>> payloads;  // insertion order
+  std::unordered_set<std::string> bad_roots;
+  std::string result;
+
+  int k() const;
+  PerRoot& per_root(const std::string& root);
+  void on_request(bool has_value, const std::string& value);
+  void on_val(int sender, const Msg& m);
+  void on_echo(int sender, const Msg& m);
+  void on_ready(int sender, const Msg& m);
+  bool check_branch(const Msg& m);
+  void try_interpolate(const std::string& root);
+  void try_deliver();
+  const std::string* payload_of(const std::string& root) const {
+    for (auto& pr : payloads)
+      if (pr.first == root) return &pr.second;
+    return nullptr;
+  }
+  void emit();
+};
+
+// --- CommonSubset (common_subset.py; CommonSubset.cs) ----------------------
+struct ACS {
+  Engine* E;
+  int vid;
+  std::unordered_map<int, std::string> rbc_results;
+  std::unordered_map<int, int8_t> ba_results;
+  std::unordered_set<int> ba_inputs;
+  bool filled_zeros = false;
+  bool done = false, parented = false, terminated = false;
+
+  void on_request(const std::string& data);
+  void on_rbc_result(int j, const std::string& v);
+  void on_ba_result(int j, bool v);
+  void vote(int j, bool v);
+  void try_complete();
+};
+
+struct Validator {
+  int era = 0;
+  std::unordered_map<uint64_t, BB*> bb;   // key (agreement+1)<<32 | epoch
+  std::unordered_map<int, BA*> ba;
+  std::unordered_map<int, RBC*> rbc;
+  ACS* acs = nullptr;
+  std::vector<Entry> postponed;
+  std::unordered_map<int, int> postponed_per_sender;
+
+  void clear_protocols() {
+    for (auto& kv : bb) delete kv.second;
+    bb.clear();
+    for (auto& kv : ba) delete kv.second;
+    ba.clear();
+    for (auto& kv : rbc) delete kv.second;
+    rbc.clear();
+    delete acs;
+    acs = nullptr;
+  }
+};
+
+struct Engine {
+  int n, f;
+  int mode;               // 0 FIFO, 1 LIFO, 2 RANDOM
+  uint32_t repeat_ppm;    // duplicate-injection probability, parts/million
+  uint64_t rng_state;
+  std::deque<Entry> q;
+  std::vector<Validator> vals;
+  Bits muted;
+  uint64_t delivered = 0;
+  uint64_t opq_pending[8] = {0};  // queued opaque entries per kind (flush cue)
+  bool stop_req = false;  // pulsed by Python on top-level protocol completion
+  int postponed_sender_cap = 256;  // era.py::_postponed_sender_cap
+  opaque_cb_t cb_opaque = nullptr;
+  acs_cb_t cb_acs = nullptr;
+  coinreq_cb_t cb_coinreq = nullptr;
+
+  Engine(int n_, int f_, int mode_, uint32_t ppm, uint64_t seed, int era0)
+      : n(n_), f(f_), mode(mode_), repeat_ppm(ppm) {
+    rng_state = seed * 0x9E3779B97F4A7C15ULL + 1;
+    vals.resize(n);
+    for (auto& v : vals) v.era = era0;
+    gf_init();
+  }
+  ~Engine() {
+    for (auto& v : vals) {
+      v.clear_protocols();
+      for (auto& e : v.postponed) msg_release(e.m);
+    }
+    while (!q.empty()) {
+      msg_release(q.front().m);
+      q.pop_front();
+    }
+  }
+
+  inline uint64_t rng_next() {
+    // xorshift64*: deterministic, seed-replayable
+    uint64_t x = rng_state;
+    x ^= x >> 12;
+    x ^= x << 25;
+    x ^= x >> 27;
+    rng_state = x;
+    return x * 0x2545F4914F6CDD1DULL;
+  }
+
+  // -- emission (simulator.py::_make_send ordering: targets 0..n-1) ---------
+  void bcast(int sender, Msg* m) {
+    if (muted.test(sender)) {
+      if (m->refs == 0) delete m;
+      return;
+    }
+    if (m->type == MT_OPAQUE) opq_pending[m->opq_kind & 7] += n;
+    for (int t = 0; t < n; t++) {
+      m->refs++;
+      q.push_back({sender, t, m});
+    }
+  }
+  void sendto(int sender, int target, Msg* m) {
+    if (muted.test(sender)) {
+      if (m->refs == 0) delete m;
+      return;
+    }
+    if (m->type == MT_OPAQUE) opq_pending[m->opq_kind & 7]++;
+    m->refs++;
+    q.push_back({sender, target, m});
+  }
+
+  // -- adversarial pop (simulator.py::_pop) ---------------------------------
+  Entry pop() {
+    Entry item;
+    if (mode == 0) {
+      item = q.front();
+      q.pop_front();
+    } else if (mode == 1) {
+      item = q.back();
+      q.pop_back();
+    } else {
+      size_t idx = (size_t)(rng_next() % q.size());
+      Entry last = q.back();
+      q.pop_back();
+      if (idx < q.size()) {
+        item = q[idx];
+        q[idx] = last;
+      } else {
+        item = last;
+      }
+    }
+    if (item.m->type == MT_OPAQUE) opq_pending[item.m->opq_kind & 7]--;
+    if (repeat_ppm > 0 && (uint32_t)(rng_next() % 1000000u) < repeat_ppm) {
+      item.m->refs++;
+      if (item.m->type == MT_OPAQUE) opq_pending[item.m->opq_kind & 7]++;
+      q.push_back(item);  // duplicate injection
+    }
+    return item;
+  }
+
+  // -- protocol lookup/create (era.py::_ensure_protocol + _validate_id) -----
+  BB* get_bb(Validator& V, int agreement, int epoch, bool create) {
+    if (!((agreement >= 0 && agreement < n) || agreement == -1) || epoch < 0)
+      return nullptr;
+    uint64_t key = ((uint64_t)(uint32_t)(agreement + 1) << 32) |
+                   (uint32_t)epoch;
+    auto it = V.bb.find(key);
+    if (it != V.bb.end())
+      return it->second->terminated ? nullptr : it->second;
+    if (!create) return nullptr;
+    BB* b = new BB();
+    b->E = this;
+    b->vid = (int)(&V - vals.data());
+    b->agreement = agreement;
+    b->epoch = epoch;
+    V.bb[key] = b;
+    return b;
+  }
+  BA* get_ba(Validator& V, int agreement, bool create) {
+    if (agreement < 0 || agreement >= n) return nullptr;
+    auto it = V.ba.find(agreement);
+    if (it != V.ba.end())
+      return it->second->terminated ? nullptr : it->second;
+    if (!create) return nullptr;
+    BA* b = new BA();
+    b->E = this;
+    b->vid = (int)(&V - vals.data());
+    b->agreement = agreement;
+    V.ba[agreement] = b;
+    return b;
+  }
+  RBC* get_rbc(Validator& V, int slot, bool create) {
+    if (slot < 0 || slot >= n) return nullptr;
+    auto it = V.rbc.find(slot);
+    if (it != V.rbc.end())
+      return it->second->terminated ? nullptr : it->second;
+    if (!create) return nullptr;
+    RBC* r = new RBC();
+    r->E = this;
+    r->vid = (int)(&V - vals.data());
+    r->slot = slot;
+    V.rbc[slot] = r;
+    return r;
+  }
+
+  // -- delivery (simulator.py::run + era.py::dispatch_external) -------------
+  void deliver(const Entry& e) {
+    Validator& V = vals[e.target];
+    Msg* m = e.m;
+    if (m->era != V.era) {
+      if (m->era > V.era) {
+        int& cnt = V.postponed_per_sender[e.sender];
+        if (cnt < postponed_sender_cap) {
+          cnt++;
+          m->refs++;
+          V.postponed.push_back(e);
+        }
+      }
+      return;  // stale era: drop
+    }
+    switch (m->type) {
+      case MT_BVAL: {
+        BB* b = get_bb(V, m->agreement, m->epoch, true);
+        if (b) b->on_bval(e.sender, m->value);
+        break;
+      }
+      case MT_AUX: {
+        BB* b = get_bb(V, m->agreement, m->epoch, true);
+        if (b) b->on_aux(e.sender, m->value);
+        break;
+      }
+      case MT_CONF: {
+        BB* b = get_bb(V, m->agreement, m->epoch, true);
+        if (b) b->on_conf(e.sender, m->value);
+        break;
+      }
+      case MT_VAL: {
+        RBC* r = get_rbc(V, m->agreement, true);
+        if (r) r->on_val(e.sender, *m);
+        break;
+      }
+      case MT_ECHO: {
+        RBC* r = get_rbc(V, m->agreement, true);
+        if (r) r->on_echo(e.sender, *m);
+        break;
+      }
+      case MT_READY: {
+        RBC* r = get_rbc(V, m->agreement, true);
+        if (r) r->on_ready(e.sender, *m);
+        break;
+      }
+      case MT_OPAQUE:
+        if (cb_opaque)
+          cb_opaque(e.target, e.sender, m->era, m->opq_kind, m->agreement,
+                    m->epoch, reinterpret_cast<const uint8_t*>(m->data.data()),
+                    m->data.size());
+        break;
+    }
+  }
+
+  size_t run(size_t max_msgs) {
+    // stop_req lets the driver re-evaluate its done() condition the moment a
+    // top-level Python protocol completes, instead of draining the rest of
+    // the chunk — the Python simulator checks done() before every pop
+    // (simulator.py::run), and overshooting past completion is not just
+    // wasted work: extra BinaryAgreement lag rounds spawn real common coins
+    // (threshold BLS sign/verify per validator) that a prompt stop avoids.
+    size_t processed = 0;
+    stop_req = false;
+    while (processed < max_msgs && !q.empty() && !stop_req) {
+      Entry e = pop();
+      delivered++;
+      processed++;
+      if (!muted.test(e.target)) deliver(e);
+      msg_release(e.m);
+    }
+    stop_req = false;
+    return processed;
+  }
+
+  void advance_era(int vid, int new_era) {
+    Validator& V = vals[vid];
+    if (new_era <= V.era) return;  // eras never regress (era.py:122-132)
+    V.era = new_era;
+    V.clear_protocols();
+    std::vector<Entry> pending;
+    pending.swap(V.postponed);
+    V.postponed_per_sender.clear();
+    for (auto& e : pending) {
+      deliver(e);  // re-postpones still-future messages
+      msg_release(e.m);
+    }
+  }
+
+  // -- results plumbing -----------------------------------------------------
+  void deliver_bb_result(int vid, int agreement, int epoch, uint8_t set) {
+    auto it = vals[vid].ba.find(agreement);
+    if (it != vals[vid].ba.end()) it->second->on_bb_result(epoch, set);
+  }
+  void deliver_ba_result(int vid, int agreement, bool v) {
+    ACS* a = vals[vid].acs;
+    if (a) a->on_ba_result(agreement, v);
+  }
+  void deliver_rbc_result(int vid, int slot, const std::string& v) {
+    ACS* a = vals[vid].acs;
+    if (a) a->on_rbc_result(slot, v);
+  }
+  void deliver_acs_result(int vid, ACS* a) {
+    std::vector<int32_t> slots;
+    for (auto& kv : a->ba_results)
+      if (kv.second) slots.push_back(kv.first);
+    std::sort(slots.begin(), slots.end());
+    std::vector<const uint8_t*> ptrs;
+    std::vector<size_t> lens;
+    for (int32_t s : slots) {
+      const std::string& d = a->rbc_results[s];
+      ptrs.push_back(reinterpret_cast<const uint8_t*>(d.data()));
+      lens.push_back(d.size());
+    }
+    if (cb_acs)
+      cb_acs(vid, vals[vid].era, (int32_t)slots.size(), slots.data(),
+             ptrs.data(), lens.data());
+  }
+
+  // requests from native parents (synchronous, like era.py::internal_request)
+  void request_bb(int vid, int agreement, int epoch, int est) {
+    BB* b = get_bb(vals[vid], agreement, epoch, true);
+    if (b) b->on_request(est);
+  }
+  void request_ba(int vid, int agreement, int est) {
+    BA* b = get_ba(vals[vid], agreement, true);
+    if (b) b->on_request(est);
+  }
+  void request_rbc(int vid, int slot, bool has_value,
+                   const std::string& value) {
+    RBC* r = get_rbc(vals[vid], slot, true);
+    if (r) r->on_request(has_value, value);
+  }
+  void request_coin(int vid, int agreement, int epoch) {
+    if (cb_coinreq) cb_coinreq(vid, vals[vid].era, agreement, epoch);
+  }
+};
+
+}  // namespace
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// BinaryBroadcast implementation (mirrors binary_broadcast.py line order)
+// ---------------------------------------------------------------------------
+
+void BB::bcast_small(uint8_t type, uint8_t value) {
+  Msg* m = new Msg();
+  m->type = type;
+  m->era = E->vals[vid].era;
+  m->agreement = agreement;
+  m->epoch = epoch;
+  m->value = value;
+  E->bcast(vid, m);
+}
+
+void BB::emit() {
+  if (parented) E->deliver_bb_result(vid, agreement, epoch, result);
+}
+
+void BB::on_request(int est) {
+  parented = true;
+  if (done) {  // protocol.py::receive Request-replay path
+    emit();
+    return;
+  }
+  int v = est ? 1 : 0;
+  if (!(bval_sent & (1 << v))) {
+    bval_sent |= 1 << v;
+    bcast_small(MT_BVAL, (uint8_t)v);
+  }
+}
+
+void BB::on_bval(int sender, int v) {
+  v = v ? 1 : 0;
+  bval_recv[v].set(sender);
+  int cnt = bval_recv[v].count();
+  if (cnt >= E->f + 1 && !(bval_sent & (1 << v))) {
+    bval_sent |= 1 << v;
+    bcast_small(MT_BVAL, (uint8_t)v);
+  }
+  if (cnt >= 2 * E->f + 1 && !(bin_values & (1 << v))) {
+    bin_values |= 1 << v;
+    if (!aux_bcast) {
+      aux_bcast = true;
+      bcast_small(MT_AUX, (uint8_t)v);
+    }
+    progress();
+  }
+}
+
+void BB::on_aux(int sender, int v) {
+  if (aux_seen.test(sender)) return;
+  aux_seen.set(sender);
+  aux_cnt[v ? 1 : 0]++;
+  progress();
+}
+
+void BB::on_conf(int sender, uint8_t set) {
+  if (conf_seen.test(sender)) return;
+  conf_seen.set(sender);
+  conf_cnt[set & 3]++;
+  progress();
+}
+
+void BB::progress() {
+  if (done || !bin_values) return;
+  if (!conf_bcast) {
+    int aux_ok = ((bin_values & 1) ? aux_cnt[0] : 0) +
+                 ((bin_values & 2) ? aux_cnt[1] : 0);
+    if (aux_ok >= E->n - E->f) {
+      conf_bcast = true;
+      bcast_small(MT_CONF, bin_values);
+    }
+  }
+  if (conf_bcast) {
+    int conf_ok = 0;
+    for (int s = 0; s < 4; s++)
+      if ((s & ~bin_values) == 0) conf_ok += conf_cnt[s];  // subset test
+    if (conf_ok >= E->n - E->f) {
+      done = true;
+      result = bin_values;
+      emit();
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// BinaryAgreement implementation (mirrors binary_agreement.py)
+// ---------------------------------------------------------------------------
+
+void BA::emit() {
+  if (parented) E->deliver_ba_result(vid, agreement, result);
+}
+
+void BA::on_request(int est_in) {
+  parented = true;
+  if (done) {
+    emit();
+    return;
+  }
+  if (started) return;
+  started = true;
+  est = est_in ? 1 : 0;
+  advance();
+}
+
+void BA::on_bb_result(int ep, uint8_t set) {
+  if (terminated) return;
+  if (!bin_values.count(ep)) {
+    bin_values[ep] = set;
+    advance();
+  }
+}
+
+void BA::on_coin_result(int ep, bool v) {
+  if (terminated) return;
+  if (!coins.count(ep)) {
+    coins[ep] = v ? 1 : 0;
+    advance();
+  }
+}
+
+void BA::advance() {
+  while (!terminated) {
+    if (epoch % 2 == 0) {
+      if (!req_bb.count(epoch)) {
+        req_bb.insert(epoch);
+        E->request_bb(vid, agreement, epoch, est);  // may re-enter advance()
+      }
+      if (!bin_values.count(epoch)) return;  // waiting on BB result
+      epoch++;
+    } else {
+      int sched = coin_schedule(epoch);
+      int coin;
+      if (E->f == 0) {
+        coin = sched == -1 ? 1 : sched;
+      } else if (sched != -1) {
+        coin = sched;
+      } else {
+        if (!req_coin.count(epoch)) {
+          req_coin.insert(epoch);
+          E->request_coin(vid, agreement, epoch);  // Python CommonCoin
+        }
+        if (!coins.count(epoch)) return;  // waiting on coin
+        coin = coins[epoch];
+      }
+      finish_round(coin);
+    }
+  }
+}
+
+void BA::finish_round(int coin) {
+  uint8_t w = bin_values[epoch - 1];
+  if (w == 1 || w == 2) {  // singleton bin_values
+    int b = (w == 2) ? 1 : 0;
+    est = (int8_t)b;
+    if (b == coin && decided == -1) {
+      decided = (int8_t)b;
+      decide_epoch = epoch;
+      done = true;
+      result = b != 0;
+      emit();
+    }
+  } else {
+    est = (int8_t)coin;
+  }
+  epoch++;
+  if (decide_epoch != -1 && epoch > decide_epoch + 2 * EXTRA_ROUNDS)
+    terminated = true;
+}
+
+// ---------------------------------------------------------------------------
+// ReliableBroadcast implementation (mirrors reliable_broadcast.py)
+// ---------------------------------------------------------------------------
+
+int RBC::k() const {
+  int kk = E->n - 2 * E->f;
+  return kk > 1 ? kk : 1;
+}
+
+RBC::PerRoot& RBC::per_root(const std::string& root) {
+  PerRoot& pr = roots[root];
+  if (pr.shards.empty()) pr.shards.resize(E->n);
+  return pr;
+}
+
+void RBC::emit() {
+  if (parented) E->deliver_rbc_result(vid, slot, result);
+}
+
+void RBC::on_request(bool has_value, const std::string& value) {
+  parented = true;
+  if (done) {
+    emit();
+    return;
+  }
+  if (!has_value) return;  // participant-only instance
+  if (slot != vid) {
+    terminated = true;  // Python raises ValueError -> protocol terminated
+    return;
+  }
+  std::vector<std::string> shards = rs_encode(value, k(), E->n);
+  std::vector<std::string> leaves(E->n);
+  for (int i = 0; i < E->n; i++) leaves[i] = keccak_s(shards[i]);
+  std::string root = merkle_root(leaves);
+  for (int i = 0; i < E->n; i++) {
+    Msg* m = new Msg();
+    m->type = MT_VAL;
+    m->era = E->vals[vid].era;
+    m->agreement = slot;
+    m->root = root;
+    m->branch = merkle_proof(leaves, i);
+    m->data = shards[i];
+    m->shard_index = i;
+    E->sendto(vid, i, m);
+  }
+}
+
+bool RBC::check_branch(const Msg& m) {
+  return merkle_verify(keccak_s(m.data), (size_t)m.shard_index, m.branch,
+                       m.root);
+}
+
+void RBC::on_val(int sender, const Msg& m) {
+  if (sender != slot || val_seen) return;
+  if (m.shard_index != vid) return;
+  if (!check_branch(m)) return;
+  val_seen = true;
+  if (!echo_sent) {
+    echo_sent = true;
+    Msg* e = new Msg();
+    e->type = MT_ECHO;
+    e->era = E->vals[vid].era;
+    e->agreement = slot;
+    e->root = m.root;
+    e->branch = m.branch;
+    e->data = m.data;
+    e->shard_index = m.shard_index;
+    E->bcast(vid, e);
+  }
+}
+
+void RBC::on_echo(int sender, const Msg& m) {
+  if (m.shard_index != sender) return;  // each validator echoes its own shard
+  if (!check_branch(m)) return;
+  PerRoot& pr = per_root(m.root);
+  if (!pr.shards[sender].empty()) return;
+  pr.shards[sender] = m.data;
+  pr.have++;
+  try_interpolate(m.root);
+  try_deliver();
+}
+
+void RBC::on_ready(int sender, const Msg& m) {
+  PerRoot& pr = per_root(m.root);
+  if (pr.ready.test(sender)) return;
+  pr.ready.set(sender);
+  if (pr.ready.count() >= E->f + 1 && !ready_sent) {
+    ready_sent = true;
+    Msg* r = new Msg();
+    r->type = MT_READY;
+    r->era = E->vals[vid].era;
+    r->agreement = slot;
+    r->root = m.root;
+    E->bcast(vid, r);
+  }
+  try_deliver();
+}
+
+void RBC::try_interpolate(const std::string& root) {
+  if (payload_of(root) || bad_roots.count(root)) return;
+  PerRoot& pr = per_root(root);
+  if (pr.have < E->n - 2 * E->f) return;
+  std::string payload;
+  if (!rs_decode(pr.shards, k(), payload)) {
+    bad_roots.insert(root);
+    return;
+  }
+  // malicious-sender check: re-encode and recompute the Merkle root
+  std::vector<std::string> reencoded = rs_encode(payload, k(), E->n);
+  std::vector<std::string> leaves(E->n);
+  for (int i = 0; i < E->n; i++) leaves[i] = keccak_s(reencoded[i]);
+  if (merkle_root(leaves) != root) {
+    bad_roots.insert(root);  // equivocated shards: never deliver
+    return;
+  }
+  payloads.emplace_back(root, payload);
+  if (!ready_sent) {
+    ready_sent = true;
+    Msg* r = new Msg();
+    r->type = MT_READY;
+    r->era = E->vals[vid].era;
+    r->agreement = slot;
+    r->root = root;
+    E->bcast(vid, r);
+  }
+  try_deliver();
+}
+
+void RBC::try_deliver() {
+  if (delivered) return;
+  for (auto& rp : payloads) {
+    auto it = roots.find(rp.first);
+    if (it != roots.end() && it->second.ready.count() >= 2 * E->f + 1) {
+      delivered = true;
+      done = true;
+      result = rp.second;
+      emit();
+      return;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CommonSubset implementation (mirrors common_subset.py)
+// ---------------------------------------------------------------------------
+
+void ACS::on_request(const std::string& data) {
+  parented = true;
+  if (done) {
+    E->deliver_acs_result(vid, this);
+    return;
+  }
+  for (int j = 0; j < E->n; j++)
+    E->request_rbc(vid, j, j == vid, j == vid ? data : std::string());
+}
+
+void ACS::on_rbc_result(int j, const std::string& v) {
+  if (terminated) return;
+  if (rbc_results.count(j)) return;
+  rbc_results[j] = v;
+  vote(j, true);
+  try_complete();
+}
+
+void ACS::on_ba_result(int j, bool v) {
+  if (terminated) return;
+  if (ba_results.count(j)) return;
+  ba_results[j] = v ? 1 : 0;
+  int ones = 0;
+  for (auto& kv : ba_results)
+    if (kv.second) ones++;
+  if (ones >= E->n - E->f && !filled_zeros) {
+    filled_zeros = true;
+    for (int kk = 0; kk < E->n; kk++)
+      if (!ba_results.count(kk)) vote(kk, false);
+  }
+  try_complete();
+}
+
+void ACS::vote(int j, bool v) {
+  if (ba_inputs.count(j)) return;
+  ba_inputs.insert(j);
+  E->request_ba(vid, j, v ? 1 : 0);
+}
+
+void ACS::try_complete() {
+  if (done || (int)ba_results.size() < E->n) return;
+  for (auto& kv : ba_results)
+    if (kv.second && !rbc_results.count(kv.first)) return;  // value pending
+  done = true;
+  E->deliver_acs_result(vid, this);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (ctypes binding: lachain_tpu/consensus/native_rt.py)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int lt_crt_version() { return 1; }
+
+void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
+             int era0) {
+  return new Engine(n, f, mode, repeat_ppm, seed, era0);
+}
+
+void rt_free(void* h) { delete static_cast<Engine*>(h); }
+
+void rt_set_callbacks(void* h, opaque_cb_t o, acs_cb_t a, coinreq_cb_t c) {
+  Engine* E = static_cast<Engine*>(h);
+  E->cb_opaque = o;
+  E->cb_acs = a;
+  E->cb_coinreq = c;
+}
+
+void rt_mute(void* h, int vid) { static_cast<Engine*>(h)->muted.set(vid); }
+
+void rt_advance_era(void* h, int vid, int era) {
+  static_cast<Engine*>(h)->advance_era(vid, era);
+}
+
+void rt_post_acs_input(void* h, int vid, const uint8_t* data, size_t len) {
+  Engine* E = static_cast<Engine*>(h);
+  Validator& V = E->vals[vid];
+  if (!V.acs) {
+    V.acs = new ACS();
+    V.acs->E = E;
+    V.acs->vid = vid;
+  }
+  V.acs->on_request(std::string(reinterpret_cast<const char*>(data), len));
+}
+
+void rt_post_coin_result(void* h, int vid, int agreement, int epoch,
+                         int value) {
+  Engine* E = static_cast<Engine*>(h);
+  auto it = E->vals[vid].ba.find(agreement);
+  if (it != E->vals[vid].ba.end())
+    it->second->on_coin_result(epoch, value != 0);
+}
+
+void rt_broadcast_opaque(void* h, int vid, int kind, int agreement, int epoch,
+                         const uint8_t* data, size_t len) {
+  Engine* E = static_cast<Engine*>(h);
+  Msg* m = new Msg();
+  m->type = MT_OPAQUE;
+  m->era = E->vals[vid].era;
+  m->opq_kind = (uint8_t)kind;
+  m->agreement = agreement;
+  m->epoch = epoch;
+  m->data.assign(reinterpret_cast<const char*>(data), len);
+  E->bcast(vid, m);
+}
+
+size_t rt_run(void* h, size_t max_msgs) {
+  return static_cast<Engine*>(h)->run(max_msgs);
+}
+
+void rt_request_stop(void* h) { static_cast<Engine*>(h)->stop_req = true; }
+
+uint64_t rt_opaque_pending(void* h, int kind) {
+  return static_cast<Engine*>(h)->opq_pending[kind & 7];
+}
+
+size_t rt_queue_len(void* h) { return static_cast<Engine*>(h)->q.size(); }
+
+uint64_t rt_delivered(void* h) { return static_cast<Engine*>(h)->delivered; }
+
+}  // extern "C"

@@ -1,0 +1,481 @@
+"""ctypes binding for the native consensus engine (libconsensus_rt).
+
+`NativeSimulatedNetwork` is a drop-in for `simulator.SimulatedNetwork`: the
+delivery queue and the flood protocols (BinaryBroadcast, BinaryAgreement,
+ReliableBroadcast, CommonSubset) run inside the C++ engine
+(native/consensus_rt.cpp), while every crypto-bearing protocol — CommonCoin,
+HoneyBadger, RootProtocol — remains the existing Python class, its messages
+crossing the engine as opaque payloads. The split keeps the Python crypto
+stack (and the TPU-batched era kernel it drives) as the single source of
+cryptographic truth while removing the Python per-message dispatch cost that
+dominated N=64 eras (benchmarks/results_r03.json: 479.5 s, 2.45 M messages).
+
+Reference roles covered: AbstractProtocol's thread+queue runtime
+(/root/reference/src/Lachain.Consensus/AbstractProtocol.cs:11-168) and the
+test DeliveryService (test/Lachain.ConsensusTest/DeliverySerivce.cs:10-124).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from . import messages as M
+from .era import EraRouter
+from .keys import PrivateConsensusKeys, PublicConsensusKeys
+from .simulator import DeliveryMode
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libconsensus_rt.so")
+
+# opaque payload kinds (shared contract with consensus_rt.cpp MT_OPAQUE)
+KIND_DECRYPTED = 0
+KIND_SIGNED_HEADER = 1
+KIND_COIN = 2
+
+_OPAQUE_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_int32,  # target
+    ctypes.c_int32,  # sender
+    ctypes.c_int32,  # era
+    ctypes.c_int32,  # kind
+    ctypes.c_int32,  # agreement
+    ctypes.c_int32,  # epoch
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_size_t,
+)
+_ACS_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_int32,  # target
+    ctypes.c_int32,  # era
+    ctypes.c_int32,  # nslots
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+    ctypes.POINTER(ctypes.c_size_t),
+)
+_COINREQ_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32
+)
+
+_lib_cache: List[Any] = [None]
+
+
+def load_rt():
+    if _lib_cache[0] is not None:
+        return _lib_cache[0]
+    sources = [
+        os.path.join(_NATIVE_DIR, "consensus_rt.cpp"),
+        os.path.join(_NATIVE_DIR, "Makefile"),
+    ]
+    if not os.path.exists(_LIB_PATH) or any(
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
+    ):
+        subprocess.run(
+            ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
+        )
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.lt_crt_version.restype = ctypes.c_int
+    assert lib.lt_crt_version() == 1
+    lib.rt_new.restype = ctypes.c_void_p
+    lib.rt_new.argtypes = [
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.rt_free.argtypes = [ctypes.c_void_p]
+    lib.rt_set_callbacks.argtypes = [
+        ctypes.c_void_p,
+        _OPAQUE_CB,
+        _ACS_CB,
+        _COINREQ_CB,
+    ]
+    lib.rt_mute.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rt_advance_era.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.rt_post_acs_input.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rt_post_coin_result.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.rt_broadcast_opaque.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rt_run.restype = ctypes.c_size_t
+    lib.rt_run.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.rt_request_stop.argtypes = [ctypes.c_void_p]
+    lib.rt_opaque_pending.restype = ctypes.c_uint64
+    lib.rt_opaque_pending.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rt_queue_len.restype = ctypes.c_size_t
+    lib.rt_queue_len.argtypes = [ctypes.c_void_p]
+    lib.rt_delivered.restype = ctypes.c_uint64
+    lib.rt_delivered.argtypes = [ctypes.c_void_p]
+    _lib_cache[0] = lib
+    return lib
+
+
+@dataclass(frozen=True)
+class NativeCoinParent:
+    """Result address for a CommonCoin requested by a NATIVE BinaryAgreement:
+    the Python coin's emit_result routes back into the engine."""
+
+    agreement: int
+    epoch: int
+
+
+class NativeEraRouter(EraRouter):
+    """EraRouter whose flood protocols live in the native engine.
+
+    Python-side protocols (Root/HoneyBadger/CommonCoin) are created and routed
+    exactly as in EraRouter; requests addressed to natively-owned protocol ids
+    divert into the engine, and engine callbacks re-enter through
+    `_on_opaque` / `_on_acs_result` / `_on_coin_request`.
+    """
+
+    def __init__(
+        self,
+        era: int,
+        my_id: int,
+        public_keys: PublicConsensusKeys,
+        private_keys: PrivateConsensusKeys,
+        net: "NativeSimulatedNetwork",
+        extra_factories=None,
+    ):
+        def _no_send(target, payload):  # pragma: no cover
+            raise RuntimeError("native router transports via the engine")
+
+        super().__init__(
+            era,
+            my_id,
+            public_keys,
+            private_keys,
+            send=_no_send,
+            extra_factories=extra_factories,
+        )
+        self._net = net
+        self._acs_parent: Any = None
+
+    # -- outbound: divert into the engine -------------------------------------
+    def internal_request(self, req: M.Request) -> None:
+        to = req.to_id
+        if isinstance(to, M.CommonSubsetId):
+            self._acs_parent = req.from_id
+            self._net._post_acs_input(self._my_id, req.input)
+            return
+        if isinstance(
+            to,
+            (M.BinaryAgreementId, M.BinaryBroadcastId, M.ReliableBroadcastId),
+        ):
+            raise RuntimeError(f"natively-owned protocol requested: {to}")
+        super().internal_request(req)
+
+    def internal_response(self, res: M.Result) -> None:
+        if isinstance(res.to_id, NativeCoinParent):
+            self._net._post_coin_result(
+                self._my_id, res.to_id.agreement, res.to_id.epoch, res.value
+            )
+            return
+        if res.to_id is None:
+            # top-level protocol completed (e.g. Root produced its block):
+            # break the engine out of its chunk so the driver can re-check
+            # done() promptly — mirrors the Python simulator's per-message
+            # done() check and keeps lag-round coin work off the hot path
+            self._net._request_stop()
+            return
+        super().internal_response(res)
+
+    def broadcast(self, payload) -> None:
+        if isinstance(payload, M.DecryptedMessage):
+            self._net._bcast_opaque(
+                self._my_id, KIND_DECRYPTED, payload.share_id, 0, payload.payload
+            )
+        elif isinstance(payload, M.SignedHeaderMessage):
+            data = (
+                len(payload.header_bytes).to_bytes(4, "big")
+                + payload.header_bytes
+                + payload.signature
+            )
+            self._net._bcast_opaque(self._my_id, KIND_SIGNED_HEADER, 0, 0, data)
+        elif isinstance(payload, M.CoinMessage):
+            self._net._bcast_opaque(
+                self._my_id,
+                KIND_COIN,
+                payload.coin.agreement,
+                payload.coin.epoch,
+                payload.share,
+            )
+        else:
+            raise TypeError(f"unexpected python-protocol payload {type(payload)}")
+
+    def send_to(self, validator: int, payload) -> None:
+        raise TypeError("python-side protocols only broadcast")
+
+    def _create(self, pid):
+        if isinstance(
+            pid,
+            (
+                M.BinaryBroadcastId,
+                M.BinaryAgreementId,
+                M.ReliableBroadcastId,
+                M.CommonSubsetId,
+            ),
+        ):
+            raise RuntimeError(f"natively-owned protocol id {pid}")
+        return super()._create(pid)
+
+    def advance_era(self, new_era: int) -> None:
+        if new_era <= self.era:
+            return
+        super().advance_era(new_era)
+        self._net._advance_era(self._my_id, new_era)
+
+    # -- engine callbacks ------------------------------------------------------
+    def _on_opaque(
+        self, sender: int, era: int, kind: int, agreement: int, epoch: int, data: bytes
+    ) -> None:
+        if kind == KIND_DECRYPTED:
+            payload = M.DecryptedMessage(
+                hb=M.HoneyBadgerId(era=era), share_id=agreement, payload=data
+            )
+        elif kind == KIND_SIGNED_HEADER:
+            hlen = int.from_bytes(data[:4], "big")
+            payload = M.SignedHeaderMessage(
+                root=M.RootProtocolId(era=era),
+                header_bytes=data[4 : 4 + hlen],
+                signature=data[4 + hlen :],
+            )
+        elif kind == KIND_COIN:
+            payload = M.CoinMessage(
+                coin=M.CoinId(era=era, agreement=agreement, epoch=epoch),
+                share=data,
+            )
+        else:  # unknown kind: drop (forward-compat)
+            return
+        self.dispatch_external(sender, payload)
+
+    def _on_acs_result(self, era: int, result: Dict[int, bytes]) -> None:
+        self.internal_response(
+            M.Result(
+                from_id=M.CommonSubsetId(era=era),
+                to_id=self._acs_parent,
+                value=result,
+            )
+        )
+
+    def _on_coin_request(self, era: int, agreement: int, epoch: int) -> None:
+        cid = M.CoinId(era=era, agreement=agreement, epoch=epoch)
+        super().internal_request(
+            M.Request(
+                from_id=NativeCoinParent(agreement=agreement, epoch=epoch),
+                to_id=cid,
+                input=None,
+            )
+        )
+
+
+class NativeSimulatedNetwork:
+    """Drop-in for simulator.SimulatedNetwork backed by the C++ engine."""
+
+    def __init__(
+        self,
+        public_keys: PublicConsensusKeys,
+        private_keys: List[PrivateConsensusKeys],
+        era: int = 0,
+        seed: int = 0,
+        mode: DeliveryMode = DeliveryMode.TAKE_FIRST,
+        repeat_probability: float = 0.0,
+        muted: Optional[Set[int]] = None,
+        extra_factories=None,
+        use_crypto_batcher: bool = True,
+    ):
+        self.n = public_keys.n
+        self.mode = mode
+        self.muted = muted or set()
+        self._lib = load_rt()
+        mode_i = {
+            DeliveryMode.TAKE_FIRST: 0,
+            DeliveryMode.TAKE_LAST: 1,
+            DeliveryMode.TAKE_RANDOM: 2,
+        }[mode]
+        self._h = self._lib.rt_new(
+            self.n,
+            public_keys.f,
+            mode_i,
+            int(repeat_probability * 1_000_000),
+            seed,
+            era,
+        )
+        for v in self.muted:
+            self._lib.rt_mute(self._h, v)
+        self.routers: List[NativeEraRouter] = [
+            NativeEraRouter(
+                era=era,
+                my_id=i,
+                public_keys=public_keys,
+                private_keys=private_keys[i],
+                net=self,
+                extra_factories=extra_factories,
+            )
+            for i in range(self.n)
+        ]
+        self._cb_error: Optional[BaseException] = None
+        # keep CFUNCTYPE objects alive for the engine's lifetime
+        self._cbs = (
+            _OPAQUE_CB(self._cb_opaque),
+            _ACS_CB(self._cb_acs),
+            _COINREQ_CB(self._cb_coinreq),
+        )
+        self._lib.rt_set_callbacks(self._h, *self._cbs)
+        self.delivered_count = 0
+        # router-level TPKE flush batcher (crypto_batcher.py): flushed by
+        # run() once every queued DecryptedMessage has been delivered — the
+        # point where the cross-validator batch is largest
+        self.crypto_batcher = None
+        if use_crypto_batcher:
+            from .crypto_batcher import TpkeEraBatcher
+
+            self.crypto_batcher = TpkeEraBatcher()
+            for r in self.routers:
+                r.crypto_batcher = self.crypto_batcher
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.rt_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- engine entry points ---------------------------------------------------
+    def _post_acs_input(self, vid: int, data: bytes) -> None:
+        self._lib.rt_post_acs_input(self._h, vid, data, len(data))
+
+    def _post_coin_result(self, vid: int, agreement: int, epoch: int, value) -> None:
+        self._lib.rt_post_coin_result(
+            self._h, vid, agreement, epoch, 1 if value else 0
+        )
+
+    def _bcast_opaque(
+        self, vid: int, kind: int, agreement: int, epoch: int, data: bytes
+    ) -> None:
+        self._lib.rt_broadcast_opaque(
+            self._h, vid, kind, agreement, epoch, data, len(data)
+        )
+
+    def _advance_era(self, vid: int, era: int) -> None:
+        self._lib.rt_advance_era(self._h, vid, era)
+
+    def _request_stop(self) -> None:
+        self._lib.rt_request_stop(self._h)
+
+    def mute(self, vid: int) -> None:
+        self.muted.add(vid)
+        self._lib.rt_mute(self._h, vid)
+
+    # -- callbacks (engine -> Python); exceptions are stashed and re-raised
+    #    from run(), since they cannot unwind through the C++ frames ----------
+    def _cb_opaque(self, target, sender, era, kind, agreement, epoch, data, length):
+        if self._cb_error is not None:
+            return
+        try:
+            blob = ctypes.string_at(data, length) if length else b""
+            self.routers[target]._on_opaque(
+                sender, era, kind, agreement, epoch, blob
+            )
+            if (
+                kind == KIND_DECRYPTED
+                and self.crypto_batcher is not None
+                and self.crypto_batcher.pending
+                and self._lib.rt_opaque_pending(self._h, KIND_DECRYPTED) == 0
+            ):
+                # all decryption shares delivered: break out so run() can
+                # flush the cross-validator batch before lag-round traffic
+                self._lib.rt_request_stop(self._h)
+        except BaseException as exc:  # noqa: BLE001
+            self._cb_error = exc
+
+    def _cb_acs(self, target, era, nslots, slots, datas, lens):
+        if self._cb_error is not None:
+            return
+        try:
+            result = {
+                int(slots[i]): (
+                    ctypes.string_at(datas[i], lens[i]) if lens[i] else b""
+                )
+                for i in range(nslots)
+            }
+            self.routers[target]._on_acs_result(era, result)
+        except BaseException as exc:  # noqa: BLE001
+            self._cb_error = exc
+
+    def _cb_coinreq(self, target, era, agreement, epoch):
+        if self._cb_error is not None:
+            return
+        try:
+            self.routers[target]._on_coin_request(era, agreement, epoch)
+        except BaseException as exc:  # noqa: BLE001
+            self._cb_error = exc
+
+    # -- execution (simulator.py::run contract) --------------------------------
+    def post_request(self, validator: int, pid, value) -> None:
+        self.routers[validator].internal_request(
+            M.Request(from_id=None, to_id=pid, input=value)
+        )
+
+    def run(
+        self,
+        done: Callable[[], bool],
+        max_messages: int = 1_000_000,
+        chunk: int = 16384,
+    ) -> bool:
+        while not done():
+            processed = self._lib.rt_run(self._h, chunk)
+            self.delivered_count += processed
+            if self._cb_error is not None:
+                err, self._cb_error = self._cb_error, None
+                raise err
+            if (
+                self.crypto_batcher is not None
+                and self.crypto_batcher.pending
+                and (
+                    self._lib.rt_queue_len(self._h) == 0
+                    or self._lib.rt_opaque_pending(self._h, KIND_DECRYPTED)
+                    == 0
+                )
+            ):
+                self.crypto_batcher.flush()
+                continue
+            if processed == 0:
+                return done()
+            if (
+                self.delivered_count >= max_messages
+                and self._lib.rt_queue_len(self._h) > 0
+                and not done()
+            ):
+                raise RuntimeError(
+                    f"message cap {max_messages} exceeded — livelock?"
+                )
+        return True
+
+    def results(self, pid) -> List[Any]:
+        return [r.result_of(pid) for r in self.routers]

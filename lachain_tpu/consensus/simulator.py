@@ -45,6 +45,7 @@ class SimulatedNetwork:
         muted: Optional[Set[int]] = None,
         extra_factories: Optional[Dict[type, Callable]] = None,
         router_cls=EraRouter,
+        use_crypto_batcher: bool = True,
     ):
         self.n = public_keys.n
         self.rng = random.Random(seed)
@@ -71,11 +72,24 @@ class SimulatedNetwork:
                 )
             )
         self.delivered_count = 0
+        # router-level TPKE flush batcher (crypto_batcher.py): flushed once
+        # every queued DecryptedMessage has been delivered, fusing every
+        # validator's pending verify+combine work into one backend call
+        self.crypto_batcher = None
+        self._decrypted_in_queue = 0
+        if use_crypto_batcher:
+            from .crypto_batcher import TpkeEraBatcher
+
+            self.crypto_batcher = TpkeEraBatcher()
+            for r in self.routers:
+                r.crypto_batcher = self.crypto_batcher
 
     def _make_send(self, sender: int):
         def send(target: Optional[int], payload) -> None:
             if sender in self.muted:
                 return  # crashed player: no outbound traffic
+            if type(payload) is M.DecryptedMessage:
+                self._decrypted_in_queue += self.n if target is None else 1
             if target is None:
                 for t in range(self.n):
                     self._queue.append((sender, t, payload))
@@ -101,6 +115,8 @@ class SimulatedNetwork:
             else:
                 item = last
         if self.repeat_probability > 0 and self.rng.random() < self.repeat_probability:
+            if type(item[2]) is M.DecryptedMessage:
+                self._decrypted_in_queue += 1
             self._queue.append(item)  # duplicate injection
         return item
 
@@ -117,8 +133,12 @@ class SimulatedNetwork:
         max_messages: int = 1_000_000,
     ) -> bool:
         """Deliver until `done()` or quiescence/cap. True iff done() held."""
+        batcher = self.crypto_batcher
         while not done():
             if not self._queue:
+                if batcher is not None and batcher.pending:
+                    batcher.flush()
+                    continue
                 return done()
             if self.delivered_count >= max_messages:
                 raise RuntimeError(
@@ -126,9 +146,20 @@ class SimulatedNetwork:
                 )
             sender, target, payload = self._pop()
             self.delivered_count += 1
-            if target in self.muted:
-                continue  # crashed player: no inbound processing either
-            self.routers[target].dispatch_external(sender, payload)
+            if type(payload) is M.DecryptedMessage:
+                self._decrypted_in_queue -= 1
+            if target not in self.muted:
+                # crashed player: no inbound processing either
+                self.routers[target].dispatch_external(sender, payload)
+            if (
+                batcher is not None
+                and batcher.pending
+                and self._decrypted_in_queue == 0
+            ):
+                # every broadcast decryption share has been delivered: the
+                # cross-validator batch is at its largest — flush NOW, before
+                # BinaryAgreement lag rounds spawn fresh coin work
+                batcher.flush()
         return True
 
     def results(self, pid) -> List[Any]:

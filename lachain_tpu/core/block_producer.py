@@ -66,7 +66,9 @@ class BlockProducer:
             else None
         )
         return self.pool.peek(
-            max(self.txs_per_block // max(self.n, 1), 1), rng=rng
+            max(self.txs_per_block // max(self.n, 1), 1),
+            rng=rng,
+            window_txs=2 * self.txs_per_block,
         )
 
     # -- header -----------------------------------------------------------------

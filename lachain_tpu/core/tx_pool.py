@@ -90,19 +90,27 @@ class TransactionPool:
             return nonce
 
     def peek(
-        self, max_txs: int, rng: Optional["random.Random"] = None
+        self,
+        max_txs: int,
+        rng: Optional["random.Random"] = None,
+        window_txs: Optional[int] = None,
     ) -> List[SignedTransaction]:
         """Fee-ordered proposal with per-sender nonce continuity.
 
         With `rng`, the proposal is a RANDOM sample from a fee-ordered
-        window of up to 4*max_txs executable txs (the reference's
+        window of up to `window_txs` executable txs (the reference's
         RandomSamplingQueue role, Containers/RandomSamplingQueue.cs):
         HoneyBadger blocks carry the UNION of n proposals, so diversity
         across validators — not identical top-fee picks — is what fills
-        blocks. Sampling keeps per-sender nonce chains contiguous by
-        sampling SENDERS, then taking their chain prefixes."""
+        blocks. The window must therefore span a whole BLOCK's worth of
+        txs, not one proposal's worth: n validators sampling 4*max_txs
+        txs can union to at most 4*max_txs distinct entries. Sampling
+        keeps per-sender nonce chains contiguous by sampling SENDERS,
+        then taking their chain prefixes."""
         if rng is not None:
-            window = self._peek_ordered_with_senders(4 * max_txs)
+            window = self._peek_ordered_with_senders(
+                window_txs if window_txs is not None else 4 * max_txs
+            )
             if len(window) > max_txs:
                 by_sender: Dict[bytes, List[SignedTransaction]] = {}
                 order: List[bytes] = []

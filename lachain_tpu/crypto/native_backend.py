@@ -60,6 +60,17 @@ class NativeBackend:
     def __init__(self):
         self._lib = load_lib()
 
+    def tpke_era_verify_combine(self, jobs, verification_keys, rng=None):
+        """Whole-tick TPKE verify+combine over the C++ group ops (one grand
+        multi-pairing); same contract as the TPU backend's kernel version."""
+        import secrets as _secrets
+
+        from . import tpke
+
+        return tpke.era_verify_combine_host(
+            jobs, verification_keys, backend=self, rng=rng or _secrets
+        )
+
     # -- group ops -----------------------------------------------------------
     def g1_mul(self, point: tuple, scalar: int) -> tuple:
         out = ctypes.create_string_buffer(96)

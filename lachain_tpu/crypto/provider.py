@@ -75,6 +75,18 @@ class PythonBackend:
     def g2_deserialize(self, data: bytes) -> tuple:
         return bls.g2_from_bytes(data, check_subgroup=True)
 
+    # -- era-shaped batch ops ------------------------------------------------
+    def tpke_era_verify_combine(self, jobs, verification_keys, rng=None):
+        """Whole-tick TPKE verify+combine (one grand multi-pairing); same
+        contract as the TPU backend's kernel-backed version."""
+        import secrets as _secrets
+
+        from . import tpke
+
+        return tpke.era_verify_combine_host(
+            jobs, verification_keys, backend=self, rng=rng or _secrets
+        )
+
 
 def batch_bisect_verify(group_ok, n: int) -> List[bool]:
     """Shared bisection driver for random-linear-combination batch checks.
@@ -102,6 +114,54 @@ def batch_bisect_verify(group_ok, n: int) -> List[bool]:
     if n:
         solve(list(range(n)))
     return results
+
+
+def deserialize_batch_g1(datas, backend=None, rng=None):
+    """Parse many G1 encodings; invalid entries come back as None.
+
+    Every point gets a SOUND per-point subgroup check (the backend's checked
+    deserializer). An aggregate random-linear-combination check is NOT sound
+    here: E(Fp)'s cofactor has small prime factors (3 and 11 for G1; 13/23
+    for G2's twist), so a random weight annihilates an order-3 torsion
+    component with probability 1/3 — and a rogue share surviving into a
+    combination yields divergent plaintexts across honest validators. The
+    batching wins that ARE safe (and used): parse lazily (only the t+1
+    CHOSEN shares pay the check, not all N arrivals) and memoize by exact
+    wire bytes (identical bytes validate once — in the in-process simulator
+    all N validators receive the same broadcast bytes; a real node sees the
+    same share via gossip redundancy and replays).
+    """
+    backend = backend or get_backend()
+    return [_memo_parse(d, backend.g1_deserialize, _G1_MEMO) for d in datas]
+
+
+def deserialize_batch_g2(datas, backend=None, rng=None):
+    """G2 analogue of deserialize_batch_g1 (same per-point soundness)."""
+    backend = backend or get_backend()
+    return [_memo_parse(d, backend.g2_deserialize, _G2_MEMO) for d in datas]
+
+
+# bytes -> validated point tuple (or None for invalid encodings; points are
+# immutable tuples so sharing across callers is safe). Bounded: cleared
+# wholesale at the cap — distinct entries per era are few thousand, so the
+# cap is hit rarely and a cold restart only re-validates.
+_G1_MEMO: dict = {}
+_G2_MEMO: dict = {}
+_MEMO_CAP = 1 << 18
+
+
+def _memo_parse(data, parse, memo):
+    hit = memo.get(data)
+    if hit is not None or data in memo:
+        return hit
+    try:
+        pt = parse(data)
+    except (ValueError, AssertionError):
+        pt = None
+    if len(memo) >= _MEMO_CAP:
+        memo.clear()
+    memo[bytes(data)] = pt
+    return pt
 
 
 def select_distinct(shares, key, count: int):

@@ -111,6 +111,38 @@ class EncryptedShare:
         return cls(u=u, v=v, w=w, share_id=share_id)
 
 
+def decode_encrypted_shares_batch(blobs):
+    """Parse many serialized EncryptedShares with batched subgroup checks
+    (one aggregate G1 check for the U points, one aggregate G2 check for the
+    W points — provider.deserialize_batch_*). Returns a list aligned with
+    `blobs`; malformed/invalid entries are None."""
+    from ..utils.serialization import Reader
+    from .provider import deserialize_batch_g1, deserialize_batch_g2
+
+    metas = []
+    for data in blobs:
+        try:
+            r = Reader(data[bls.G1_BYTES + bls.G2_BYTES :])
+            share_id = r.u32()
+            v = r.bytes_()
+            r.assert_eof()
+            metas.append((share_id, v))
+        except Exception:
+            metas.append(None)
+    live = [i for i, m in enumerate(metas) if m is not None]
+    us = deserialize_batch_g1([blobs[i][: bls.G1_BYTES] for i in live])
+    ws = deserialize_batch_g2(
+        [blobs[i][bls.G1_BYTES : bls.G1_BYTES + bls.G2_BYTES] for i in live]
+    )
+    out = [None] * len(blobs)
+    for j, i in enumerate(live):
+        if us[j] is None or ws[j] is None:
+            continue
+        share_id, v = metas[i]
+        out[i] = EncryptedShare(u=us[j], v=v, w=ws[j], share_id=share_id)
+    return out
+
+
 @dataclass(frozen=True)
 class PartiallyDecryptedShare:
     """One validator's decryption share U_i = U^{x_i}
@@ -250,6 +282,126 @@ class TpkePublicKey:
         cs = bls.fr_lagrange_coeffs(xs, at=0)
         y_r = get_backend().g1_msm([d.ui for d in decs], cs)
         return decrypt_with_combined(share, y_r)
+
+
+def batch_verify_ciphertexts(
+    shares: Sequence["EncryptedShare"], backend=None, rng=secrets
+) -> List[bool]:
+    """Validate many ciphertexts with one random-linear-combination
+    multi-pairing (single final exponentiation) instead of 2 pairings each
+    (reference pays the serial cost per decrypt, TPKE/PrivateKey.cs:21-27).
+    Bisects on failure to isolate invalid ciphertexts."""
+    from .provider import batch_bisect_verify, get_backend
+
+    if backend is None:
+        backend = get_backend()
+    if not shares:
+        return []
+    hs = [_hash_uv_to_g2(s.u, s.v) for s in shares]
+
+    def group_ok(idx):
+        pairs = []
+        for i in idx:
+            r_s = rng.randbelow((1 << 128) - 1) + 1
+            pairs.append((backend.g1_mul(bls.G1_GEN, r_s), shares[i].w))
+            pairs.append(
+                (backend.g1_mul(bls.g1_neg(shares[i].u), r_s), hs[i])
+            )
+        return backend.pairing_check(pairs)
+
+    return batch_bisect_verify(group_ok, len(shares))
+
+
+def peek_decrypted_share_ids(data: bytes):
+    """(decryptor_id, share_id) from a serialized PartiallyDecryptedShare
+    WITHOUT parsing the point — the ingest-path dedup/equivocation checks
+    need only the ids, so the expensive G1 parse is deferred until the share
+    is actually chosen for a combination. Returns None when malformed."""
+    if len(data) != bls.G1_BYTES + 8:
+        return None
+    return (
+        int.from_bytes(data[bls.G1_BYTES : bls.G1_BYTES + 4], "big"),
+        int.from_bytes(data[bls.G1_BYTES + 4 :], "big"),
+    )
+
+
+_Y_AGG_CACHE: dict = {}
+
+
+def _y_agg_cache_for(verification_keys) -> dict:
+    """Per-verification-key-set Y-aggregate cache (keyed by id() holding a
+    strong reference, same pattern as ops/verify.GlvEraPipeline.y_device)."""
+    key = id(verification_keys)
+    hit = _Y_AGG_CACHE.get(key)
+    if hit is not None and hit[0] is verification_keys:
+        return hit[1]
+    if len(_Y_AGG_CACHE) >= 4:
+        _Y_AGG_CACHE.pop(next(iter(_Y_AGG_CACHE)))
+    cache: dict = {}
+    _Y_AGG_CACHE[key] = (verification_keys, cache)
+    return cache
+
+
+def era_verify_combine_host(
+    jobs, verification_keys, backend=None, rng=secrets
+):
+    """Host implementation of the era verify+combine contract
+    (crypto/tpu_backend.py::TpuBackend.tpke_era_verify_combine): verify and
+    Lagrange-combine a whole era tick's worth of slots with ONE grand
+    multi-pairing (a single final exponentiation for every slot) instead of
+    2 pairings per slot.
+
+    Per slot: C = sum(lag_i * u_i), Y = sum(lag_i * y_i) over the chosen
+    t+1 lanes. Since e(., h) is injective on the prime-order subgroup for
+    h != O, `e(C, h) == e(Y, w)` holds for exactly ONE point C — the correct
+    combination — so verifying the combined point is equivalent to verifying
+    every chosen share (reference semantics TPKE/PublicKey.cs:88-92 + 55-86).
+    Slots are weighted by fresh random r_s inside the product so errors in
+    different slots cannot cancel; a failing product bisects to isolate the
+    bad slot(s), which report (False, None) and fall back to per-share
+    pruning in the caller.
+    """
+    from .provider import batch_bisect_verify, get_backend
+
+    if backend is None:
+        backend = get_backend()
+    if not jobs:
+        return []
+    entries = []
+    # most slots choose the identical first-t+1 decryptor set, so the
+    # Y = sum(lag_i * y_i) aggregate repeats verbatim — cache it per
+    # key-set (id-keyed WITH a strong reference so a collected list can
+    # never alias a new set's id) and pay ONE MSM per distinct set
+    y_cache = _y_agg_cache_for(verification_keys)
+    for job in jobs:
+        idxs = [
+            i
+            for i, c in enumerate(job.lagrange_row)
+            if c != 0 and job.u_by_validator[i] is not None
+        ]
+        cs = [job.lagrange_row[i] for i in idxs]
+        us = [job.u_by_validator[i] for i in idxs]
+        c_pt = backend.g1_msm(us, cs)
+        ykey = tuple(zip(idxs, cs))
+        y_pt = y_cache.get(ykey)
+        if y_pt is None:
+            ys = [verification_keys[i].y_i for i in idxs]
+            y_pt = backend.g1_msm(ys, cs)
+            if len(y_cache) < 4096:
+                y_cache[ykey] = y_pt
+        entries.append((c_pt, y_pt, job.h, job.w))
+
+    def group_ok(idx):
+        pairs = []
+        for t in idx:
+            c_pt, y_pt, h, w = entries[t]
+            r_s = rng.randbelow((1 << 128) - 1) + 1
+            pairs.append((backend.g1_mul(c_pt, r_s), h))
+            pairs.append((bls.g1_neg(backend.g1_mul(y_pt, r_s)), w))
+        return backend.pairing_check(pairs)
+
+    oks = batch_bisect_verify(group_ok, len(entries))
+    return [(ok, entries[t][0] if ok else None) for t, ok in enumerate(oks)]
 
 
 @dataclass(frozen=True)

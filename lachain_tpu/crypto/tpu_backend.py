@@ -135,14 +135,23 @@ class TpuBackend:
 
             from ..ops.verify import HostEraPipeline, PallasEraPipeline
 
-            # Pallas on a real chip; host-MSM emulation of the same contract
-            # elsewhere (XLA-CPU compilation of the interpret-mode kernel
-            # costs ~390 s per static shape — unusable for CI or CPU-only
-            # deployments). LTPU_FORCE_PALLAS=1 overrides for kernel debug.
-            if (
-                jax.default_backend() == "tpu"
-                or os.environ.get("LTPU_FORCE_PALLAS") == "1"
-            ):
+            # Pipeline selection:
+            #   >1 device (pod slice, or CI's virtual 8-CPU mesh) -> the
+            #     shard_mapped mesh pipeline (parallel/mesh.MeshEraPipeline):
+            #     slots data-parallel, shares sequence-parallel.
+            #   one real chip -> the VMEM-resident Pallas kernel.
+            #   CPU single-device -> host-MSM emulation of the same contract
+            #     (XLA-CPU compilation of the interpret-mode Pallas kernel
+            #     costs ~390 s per static shape — unusable for CI).
+            # LTPU_FORCE_PALLAS=1 / LTPU_DISABLE_MESH=1 override for debug.
+            n_dev = len(jax.devices())
+            if os.environ.get("LTPU_FORCE_PALLAS") == "1":
+                self._pipeline = PallasEraPipeline(self._host)
+            elif n_dev > 1 and os.environ.get("LTPU_DISABLE_MESH") != "1":
+                from ..parallel.mesh import MeshEraPipeline
+
+                self._pipeline = MeshEraPipeline(self._host)
+            elif jax.default_backend() == "tpu":
                 self._pipeline = PallasEraPipeline(self._host)
             else:
                 self._pipeline = HostEraPipeline(self._host)

@@ -365,6 +365,41 @@ def scalars_to_digits(scalars: Sequence[int], nwindows: int) -> np.ndarray:
     return out
 
 
+def era_digits(rlc_rows, lag_rows):
+    """Shared era-coefficient marshal for the GLV-kernel pipelines
+    (ops/verify.GlvEraPipeline and parallel/mesh.MeshEraPipeline): (S, K)
+    integer coefficient rows -> (rlc64, rlc_d, lag1, lag2) digit arrays,
+    with the 64-bit RLC coefficients embedded in the top W64 of W128
+    windows and the Lagrange coefficients GLV-split into halves. One
+    definition so window-width and split conventions cannot diverge between
+    the single-device and mesh topologies."""
+    s = len(rlc_rows)
+    k = len(rlc_rows[0]) if s else 0
+    rlc64 = np.stack([scalars_to_digits(row, W64) for row in rlc_rows])
+    rlc_d = np.zeros((s, k, W128), dtype=np.int32)
+    rlc_d[:, :, W128 - W64 :] = rlc64
+    lag1 = np.zeros((s, k, W128), dtype=np.int32)
+    lag2 = np.zeros((s, k, W128), dtype=np.int32)
+    for i, row in enumerate(lag_rows):
+        halves = [glv_split(v) for v in row]
+        lag1[i] = scalars_to_digits([h[0] for h in halves], W128)
+        lag2[i] = scalars_to_digits([h[1] for h in halves], W128)
+    return rlc64, rlc_d, lag1, lag2
+
+
+def combine_or_host_msm(comb, u_list, lag_list, backend):
+    """Shared incomplete-add escape hatch for the era pipelines: a combine
+    lane group degenerating to infinity (two equal partial sums collide in
+    the incomplete add tree) has no random-coefficient soundness, so the
+    ~2^-255 / adversarially-forced case falls back to the host oracle MSM."""
+    if comb[2] == 0 and any(c for c in lag_list):
+        return backend.g1_msm(
+            [u for u, c in zip(u_list, lag_list) if c],
+            [c for c in lag_list if c],
+        )
+    return comb
+
+
 def _batch_inverse(vals: List[int], p: int) -> List[int]:
     """Montgomery's trick: n field inversions for the price of one."""
     n = len(vals)

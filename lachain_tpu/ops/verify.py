@@ -204,17 +204,9 @@ class GlvEraPipeline:
             [rng.randbelow((1 << 64) - 1) + 1 for _ in range(k)]
             for _ in range(s)
         ]
-        rlc64 = np.stack(
-            [msm.scalars_to_digits(row, msm.W64) for row in rlc]
+        rlc64, rlc_d, lag1, lag2 = msm.era_digits(
+            rlc, [lag_list for _, lag_list in slots]
         )
-        rlc_d = np.zeros((s, k, msm.W128), dtype=np.int32)
-        rlc_d[:, :, msm.W128 - msm.W64 :] = rlc64
-        lag1 = np.zeros((s, k, msm.W128), dtype=np.int32)
-        lag2 = np.zeros((s, k, msm.W128), dtype=np.int32)
-        for i, (_, lag_list) in enumerate(slots):
-            halves = [msm.glv_split(v) for v in lag_list]
-            lag1[i] = msm.scalars_to_digits([h[0] for h in halves], msm.W128)
-            lag2[i] = msm.scalars_to_digits([h[1] for h in halves], msm.W128)
         pts, flags = self._kernel(
             jnp.asarray(u_np),
             jnp.asarray(rlc_d),
@@ -230,18 +222,12 @@ class GlvEraPipeline:
         out = []
         for i in range(s):
             three = msm.g1_from_device_loose(pts[i], flags[i])
-            comb = bls.g1_add(three[1], three[2])
-            if comb[2] == 0 and any(c for c in slots[i][1]):
-                # incomplete-add collision in the combine tree (two equal
-                # partial sums degenerate to (0,0,0) -> infinity). Unlike the
-                # verify lanes there is no random-coefficient soundness here,
-                # so the ~2^-255 (or adversarially-forced-share) case falls
-                # back to the host oracle MSM for this slot.
-                u_list, lag_list = slots[i]
-                comb = self._backend.g1_msm(
-                    [u for u, c in zip(u_list, lag_list) if c],
-                    [c for c in lag_list if c],
-                )
+            comb = msm.combine_or_host_msm(
+                bls.g1_add(three[1], three[2]),
+                slots[i][0],
+                slots[i][1],
+                self._backend,
+            )
             out.append((three[0], y_aggs[i], comb))
         return out, rlc
 

@@ -176,3 +176,114 @@ def pad_pow2(n: int, multiple: int) -> int:
     while size < n or size % multiple:
         size *= 2
     return size
+
+
+class MeshEraPipeline:
+    """Multi-device era pipeline: the GLV/windowed era kernel shard_mapped
+    over a ('slot', 'share') device mesh.
+
+    Same `run_era(slots, y_points, rng, masks)` contract as the single-chip
+    pipelines (ops/verify.py: GlvEraPipeline / PallasEraPipeline), selected
+    by the TPU backend whenever more than one device is visible — this is
+    how a pod slice (or the CI's 8 virtual CPU devices) runs the BASELINE
+    N=128-class era batches: ACS slots data-parallel across the 'slot' axis,
+    the within-slot share axis sequence-parallel across 'share' with an
+    explicit all_gather + flagged point-add combine.
+    """
+
+    def __init__(self, backend=None, n_devices: Optional[int] = None):
+        import jax
+
+        from ..crypto.provider import get_backend
+
+        self._backend = backend or get_backend()
+        ndev = n_devices if n_devices is not None else len(jax.devices())
+        self.mesh = make_era_mesh(ndev)
+        self._step = sharded_glv_era_step(self.mesh)
+        # era-invariant verification keys: marshal once per
+        # (key set, s_pad, k_pad) — id-keyed with a strong reference, same
+        # pattern as ops/verify's _TiledYCache
+        self._y_cache: dict = {}
+        self.calls = 0
+
+    def _y_marshal(self, y_points, s_pad: int, k_pad: int):
+        from ..crypto import bls12381 as bls
+        from ..ops import msm
+
+        key = (id(y_points), s_pad, k_pad)
+        hit = self._y_cache.get(key)
+        if hit is not None and hit[0] is y_points:
+            return hit[1]
+        k = len(y_points)
+        y_np = msm.g1_to_device_loose(
+            (list(y_points) + [bls.G1_INF] * (k_pad - k)) * s_pad
+        ).reshape(s_pad, k_pad, 3, -1)
+        if len(self._y_cache) >= 8:
+            self._y_cache.pop(next(iter(self._y_cache)))
+        self._y_cache[key] = (y_points, y_np)
+        return y_np
+
+    def run_era(self, slots, y_points, rng, masks=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..crypto import bls12381 as bls
+        from ..ops import msm
+        from ..ops.verify import era_rlc
+
+        s = len(slots)
+        k = len(y_points)
+        rlc = era_rlc(slots, k, rng, masks)
+        n_slot = self.mesh.shape["slot"]
+        n_share = self.mesh.shape["share"]
+        # pad the share axis to a power of two divisible by the 'share' mesh
+        # axis (the in-kernel tree reduce needs pow2 groups; the shard_map
+        # needs even division) and the slot axis to a multiple of 'slot'.
+        # Filler lanes carry zero coefficients -> flagged-out infinity.
+        k_pad = pad_pow2(k, n_share)
+        s_pad = ((s + n_slot - 1) // n_slot) * n_slot
+        inf = bls.G1_INF
+        u_flat = []
+        for u_list, _ in slots:
+            u_flat.extend(list(u_list) + [inf] * (k_pad - k))
+        u_flat.extend([inf] * (k_pad * (s_pad - s)))
+        u_np = msm.g1_to_device_loose(u_flat).reshape(s_pad, k_pad, 3, -1)
+        y_np = self._y_marshal(y_points, s_pad, k_pad)
+        rlc_rows = [row + [0] * (k_pad - k) for row in rlc]
+        rlc_rows += [[0] * k_pad] * (s_pad - s)
+        lag_rows = [
+            list(lag_list) + [0] * (k_pad - k) for _, lag_list in slots
+        ]
+        lag_rows += [[0] * k_pad] * (s_pad - s)
+        _rlc64, rlc_d, lag1, lag2 = msm.era_digits(rlc_rows, lag_rows)
+        with self.mesh:
+            args = []
+            for arr, spec in (
+                (u_np, P("slot", "share", None, None)),
+                (y_np, P("slot", "share", None, None)),
+                (rlc_d, P("slot", "share", None)),
+                (lag1, P("slot", "share", None)),
+                (lag2, P("slot", "share", None)),
+            ):
+                args.append(
+                    jax.device_put(
+                        jnp.asarray(arr), NamedSharding(self.mesh, spec)
+                    )
+                )
+            pts, flags = self._step(*args)
+            jax.block_until_ready((pts, flags))
+        pts = np.asarray(pts)
+        flags = np.asarray(flags)
+        self.calls += 1
+        out = []
+        for i in range(s):
+            cols = msm.g1_from_device_loose(pts[i], flags[i])
+            comb = msm.combine_or_host_msm(
+                bls.g1_add(cols[2], cols[3]),
+                slots[i][0],
+                slots[i][1],
+                self._backend,
+            )
+            out.append((cols[0], cols[1], comb))
+        return out, rlc

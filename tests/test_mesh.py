@@ -1,0 +1,190 @@
+"""Multi-device mesh tests on the virtual 8-CPU platform (conftest.py).
+
+VERDICT r3 item #2: the mesh path must be builder-owned — shard-vs-single
+bit-equality for the era step, non-power-of-two batch padding, uneven slot
+counts, and the TPU backend actually selecting the mesh pipeline when >1
+device is visible. The driver's dryrun_multichip covers compile+run; these
+cover CORRECTNESS against the host oracle.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.crypto import tpke
+from lachain_tpu.parallel.mesh import (
+    MeshEraPipeline,
+    make_era_mesh,
+    pad_pow2,
+    sharded_glv_era_step,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the virtual multi-device platform"
+)
+
+
+def _rand_points(rng, n):
+    return [bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
+
+
+def _oracle_msm(points, scalars):
+    acc = bls.G1_INF
+    for p, c in zip(points, scalars):
+        acc = bls.g1_add(acc, bls.g1_mul(p, c))
+    return acc
+
+
+def test_sharded_era_step_matches_single_device():
+    """Bit-equality: the shard_mapped era kernel on the 8-device mesh equals
+    the same kernel run unsharded on one device."""
+    from lachain_tpu.ops import msm
+
+    rng = random.Random(3)
+    mesh = make_era_mesh(len(jax.devices()))
+    n_slot, n_share = mesh.shape["slot"], mesh.shape["share"]
+    s, k = n_slot, 2 * n_share
+    pts = _rand_points(rng, s * k)
+    u = msm.g1_to_device_loose(pts).reshape(s, k, 3, -1)
+    y = msm.g1_to_device_loose(list(reversed(pts))).reshape(s, k, 3, -1)
+    rlc = msm.scalars_to_digits(
+        [rng.randrange(1, 1 << 64) for _ in range(s * k)], msm.W128
+    ).reshape(s, k, msm.W128)
+    halves = [msm.glv_split(rng.randrange(bls.R)) for _ in range(s * k)]
+    lag1 = msm.scalars_to_digits([h[0] for h in halves], msm.W128).reshape(
+        s, k, msm.W128
+    )
+    lag2 = msm.scalars_to_digits([h[1] for h in halves], msm.W128).reshape(
+        s, k, msm.W128
+    )
+
+    single_pts, single_flags = jax.jit(msm.tpke_era_glv_kernel)(
+        u, y, rlc, lag1, lag2
+    )
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    step = sharded_glv_era_step(mesh)
+    with mesh:
+        args = []
+        for arr, spec in (
+            (u, P("slot", "share", None, None)),
+            (y, P("slot", "share", None, None)),
+            (rlc, P("slot", "share", None)),
+            (lag1, P("slot", "share", None)),
+            (lag2, P("slot", "share", None)),
+        ):
+            args.append(
+                jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+            )
+        mesh_pts, mesh_flags = step(*args)
+    # decode both to canonical oracle points — limb layouts may differ in
+    # Montgomery looseness, the POINTS must be identical
+    from lachain_tpu.ops import msm as M
+
+    for i in range(s):
+        a = M.g1_from_device_loose(np.asarray(single_pts)[i], np.asarray(single_flags)[i])
+        b = M.g1_from_device_loose(np.asarray(mesh_pts)[i], np.asarray(mesh_flags)[i])
+        for pa, pb in zip(a, b):
+            assert bls.g1_eq(pa, pb)
+
+
+@pytest.mark.parametrize("s,k", [(3, 5), (1, 9), (6, 22)])
+def test_mesh_pipeline_nonpow2_padding(s, k):
+    """MeshEraPipeline pads non-pow2 share counts and non-mesh-multiple slot
+    counts; per-slot aggregates must equal the host oracle MSMs."""
+    rng = random.Random(100 + s * k)
+    pipe = MeshEraPipeline()
+    y_points = _rand_points(rng, k)
+    slots = []
+    for _ in range(s):
+        us = _rand_points(rng, k)
+        lag = [rng.randrange(1, bls.R) if i < (k + 1) // 2 else 0 for i in range(k)]
+        slots.append((us, lag))
+
+    class R:
+        def randbelow(self, n):
+            return rng.randrange(n)
+
+    out, rlc = pipe.run_era(slots, y_points, R())
+    assert len(out) == s
+    for (us, lag), (u_agg, y_agg, comb), rlc_row in zip(slots, out, rlc):
+        assert bls.g1_eq(u_agg, _oracle_msm(us, rlc_row))
+        assert bls.g1_eq(y_agg, _oracle_msm(y_points, rlc_row))
+        assert bls.g1_eq(comb, _oracle_msm(us, lag))
+
+
+def test_mesh_pipeline_masked_absent_lanes():
+    """Uneven slots: masked (absent-share) lanes contribute to neither
+    aggregate — parity with the oracle over the live lanes only."""
+    rng = random.Random(77)
+    pipe = MeshEraPipeline()
+    k = 7
+    y_points = _rand_points(rng, k)
+    us = _rand_points(rng, k)
+    masks = [[True, False, True, True, False, True, True]]
+    lag = [rng.randrange(1, bls.R) if m else 0 for m in masks[0]]
+    slots = [(
+        [u if m else bls.G1_INF for u, m in zip(us, masks[0])],
+        lag,
+    )]
+
+    class R:
+        def randbelow(self, n):
+            return rng.randrange(n)
+
+    out, rlc = pipe.run_era(slots, y_points, R(), masks=masks)
+    (u_agg, y_agg, comb) = out[0]
+    live = [i for i, m in enumerate(masks[0]) if m]
+    assert all(rlc[0][i] == 0 for i in range(k) if i not in live)
+    assert bls.g1_eq(u_agg, _oracle_msm([us[i] for i in live], [rlc[0][i] for i in live]))
+    assert bls.g1_eq(y_agg, _oracle_msm([y_points[i] for i in live], [rlc[0][i] for i in live]))
+    assert bls.g1_eq(comb, _oracle_msm([us[i] for i in live], [lag[i] for i in live]))
+
+
+def test_tpu_backend_selects_mesh_and_verifies():
+    """End-to-end: with >1 device visible the TPU backend routes
+    tpke_era_verify_combine through the mesh pipeline, and the results match
+    a full TPKE fixture (verify+combine correct, bad share rejected)."""
+    from lachain_tpu.crypto.tpu_backend import EraSlotJob, TpuBackend
+    from lachain_tpu.parallel.mesh import MeshEraPipeline as MEP
+
+    rng = random.Random(5)
+
+    class R:
+        def randbelow(self, n):
+            return rng.randrange(n)
+
+    n, f = 7, 2
+    kg = tpke.TpkeTrustedKeyGen(n, f, rng=R())
+    backend = TpuBackend(min_device_lanes=1)
+    assert isinstance(backend._get_pipeline(), MEP)
+    assert len(backend._get_pipeline().mesh.devices.flatten()) > 1
+
+    jobs = []
+    for s in range(3):
+        ct = kg.pub.encrypt(b"mesh-%d" % s, share_id=s)
+        decs = [kg.private_key(i).decrypt_share(ct, check=False) for i in range(f + 1)]
+        cs = bls.fr_lagrange_coeffs([i + 1 for i in range(f + 1)], at=0)
+        lag = [0] * n
+        u = [None] * n
+        for i, c in zip(range(f + 1), cs):
+            lag[i] = c
+            u[i] = decs[i].ui
+        if s == 2:  # corrupt one chosen share: slot must report invalid
+            u[0] = bls.g1_mul(u[0], 1337)
+        jobs.append(
+            EraSlotJob(
+                u_by_validator=u,
+                lagrange_row=lag,
+                h=tpke.ciphertext_h(ct),
+                w=ct.w,
+            )
+        )
+    res = backend.tpke_era_verify_combine(jobs, kg.verification_keys)
+    assert res[0][0] and res[1][0] and not res[2][0]
+    assert backend.era_calls == 1

@@ -1,0 +1,63 @@
+"""Subgroup-membership soundness regressions.
+
+BLS12-381's E(Fp) cofactor has small prime factors (3, 11), so an
+order-3 torsion point T = (0, 2) exists on the curve outside G1. A share
+forged as P + T passes on-curve checks and — because the pairing's final
+exponentiation annihilates order-3 components — every pairing-based verify,
+yet Lagrange-combining it yields a DIFFERENT plaintext than the honest
+subset: honest-validator divergence. Deserializers must therefore reject
+non-subgroup points with a sound PER-POINT check (an aggregate
+random-linear-combination check is not sound here: a random weight kills an
+order-3 component with probability 1/3).
+"""
+import pytest
+
+from lachain_tpu.crypto import bls12381 as bls
+from lachain_tpu.crypto.provider import (
+    deserialize_batch_g1,
+    deserialize_batch_g2,
+    get_backend,
+)
+
+# order-3 torsion point on E(Fp): y^2 = x^3 + 4 at x=0 -> (0, 2)
+T3 = (0, 2, 1)
+
+
+def _forged_share_bytes():
+    honest = bls.g1_mul(bls.G1_GEN, 123456789)
+    forged = bls.g1_add(honest, T3)
+    return bls.g1_to_bytes(forged)
+
+
+def test_torsion_point_is_on_curve_but_not_in_subgroup():
+    assert bls.g1_is_on_curve(T3)
+    assert bls.g1_is_inf(bls.g1_mul(T3, 3))
+    assert not bls.g1_is_inf(bls.g1_mul(T3, bls.R))
+
+
+def test_single_deserialize_rejects_forged_point():
+    data = _forged_share_bytes()
+    with pytest.raises(ValueError):
+        get_backend().g1_deserialize(data)
+    with pytest.raises(ValueError):
+        bls.g1_from_bytes(data, check_subgroup=True)
+
+
+def test_batch_deserialize_rejects_forged_point_every_time():
+    """The aggregate-RLC version of this check passed a forged point with
+    probability ~1/3 (or always, under the native GLV mul); the per-point
+    check must reject it on EVERY attempt."""
+    good = bls.g1_to_bytes(bls.g1_mul(bls.G1_GEN, 77))
+    forged = _forged_share_bytes()
+    for _ in range(30):
+        out = deserialize_batch_g1([good, forged, good])
+        assert out[1] is None
+        assert out[0] is not None and out[2] is not None
+
+
+def test_batch_deserialize_g2_rejects_malformed():
+    good = bls.g2_to_bytes(bls.g2_mul(bls.G2_GEN, 9))
+    bad = bytearray(good)
+    bad[5] ^= 0x42
+    out = deserialize_batch_g2([good, bytes(bad)])
+    assert out[0] is not None and out[1] is None
