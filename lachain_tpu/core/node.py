@@ -187,6 +187,16 @@ class Node:
         self._watchdog_task = asyncio.get_running_loop().create_task(
             self._protocol_watchdog()
         )
+        # TPU backends: precompile the era-kernel shapes for this validator
+        # set in the background so the first eras don't stall on Mosaic
+        # compiles (35-110 s/shape; crypto/warmup.py). Host backends: no-op.
+        try:
+            from ..crypto.warmup import warmup_era_kernels
+
+            self._warmup_thread = warmup_era_kernels(self.public_keys.n)
+        except Exception:  # pragma: no cover - warmup must never block start
+            logger.exception("kernel warmup failed to start")
+            self._warmup_thread = None
 
     async def _protocol_watchdog(self) -> None:
         """60s protocol stall watchdog with last-message breadcrumb

@@ -1,0 +1,134 @@
+"""Background kernel warmup: precompile the era-kernel shapes a node will hit.
+
+Round-3 finding (ROUND3_NOTES.md #1 / round-3 review weak #3): Mosaic kernels
+are not covered by the XLA persistent compilation cache on this platform, and
+the first era at a new (S_pad, K_pad) shape stalls 35-110 s while compiling —
+a validator joining a running chain burns its first eras compiling.
+
+The reachable shapes are known a priori: the slot axis pads to a power of two
+bounded by N, the share axis is fixed at pow2(N) — log2(N)+1 shapes total
+(tpu_backend._run_era_batch). This module compiles them on a background
+thread at node start, LARGEST FIRST (a healthy chain's first flush carries
+close to N slots), so by the time the node's first era tick reaches the
+device the hot shape is already compiled. JAX serializes compilations
+internally, so a real call racing the warmup simply waits for the same
+compile instead of duplicating it.
+
+Reference contrast: the reference has no analogous cost (MCL is AOT-compiled
+C++) — this is TPU-specific operational machinery.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Sequence
+
+logger = logging.getLogger("lachain.warmup")
+
+
+def _pow2_at_least(n: int) -> int:
+    size = 1
+    while size < n:
+        size *= 2
+    return size
+
+
+def era_warmup_shapes(n_validators: int) -> List[int]:
+    """Slot-axis sizes to precompile, largest first."""
+    top = _pow2_at_least(max(n_validators, 1))
+    shapes = []
+    s = top
+    while s >= 1:
+        shapes.append(s)
+        s //= 2
+    return shapes
+
+
+def warmup_era_kernels(
+    n_validators: int,
+    backend=None,
+    shapes: Optional[Sequence[int]] = None,
+    include_ts: bool = True,
+) -> Optional[threading.Thread]:
+    """Start a daemon thread precompiling the TPKE (and optionally the
+    G2/coin) era-kernel shapes for an N-validator chain. Returns the thread,
+    or None when the backend has no device pipeline to warm."""
+    from .provider import get_backend
+
+    backend = backend or get_backend()
+    if not hasattr(backend, "tpke_era_verify_combine") or not hasattr(
+        backend, "_get_pipeline"
+    ):
+        return None  # host backends have no compile cost to hide
+
+    def run() -> None:
+        from . import bls12381 as bls
+        from .tpu_backend import CoinJob, EraSlotJob
+
+        k = n_validators
+        todo = list(shapes) if shapes is not None else era_warmup_shapes(k)
+        for s in todo:
+            try:
+                jobs = [
+                    EraSlotJob(
+                        u_by_validator=[None] * k,
+                        lagrange_row=[0] * k,
+                        h=bls.G2_GEN,
+                        w=bls.G2_GEN,
+                    )
+                    for _ in range(s)
+                ]
+                vks = _dummy_vks(k)
+                backend.tpke_era_verify_combine(jobs, vks)
+                logger.info("warmed TPKE era shape S=%d K=%d", s, k)
+            except Exception:
+                logger.exception("era warmup failed at S=%d", s)
+                return
+        if include_ts and hasattr(backend, "ts_era_verify_combine"):
+            try:
+                jobs = [
+                    CoinJob(
+                        sigma_by_signer=[None] * k,
+                        lagrange_row=[0] * k,
+                        h=bls.G2_GEN,
+                    )
+                ]
+                backend.ts_era_verify_combine(jobs, _dummy_ts_keys(k))
+                logger.info("warmed TS coin-era shape K=%d", k)
+            except Exception:
+                logger.exception("ts era warmup failed")
+
+    t = threading.Thread(target=run, name="ltpu-kernel-warmup", daemon=True)
+    t.start()
+    return t
+
+
+_DUMMY_VKS_CACHE: dict = {}
+_DUMMY_TS_CACHE: dict = {}
+
+
+def _dummy_vks(k: int):
+    """Stable per-K dummy TPKE verification keys: the pipelines cache
+    device marshals by identity, so warmup must reuse ONE list per K (and
+    that list must not alias the real validator set's)."""
+    from . import bls12381 as bls
+    from .tpke import TpkeVerificationKey
+
+    vks = _DUMMY_VKS_CACHE.get(k)
+    if vks is None:
+        vks = [TpkeVerificationKey(bls.G1_GEN) for _ in range(k)]
+        _DUMMY_VKS_CACHE[k] = vks
+    return vks
+
+
+def _dummy_ts_keys(k: int):
+    """Stable per-K dummy threshold-signature public keys (attribute .y —
+    the coin pipeline reads TsPublicKey, not TpkeVerificationKey)."""
+    from . import bls12381 as bls
+    from .threshold_sig import TsPublicKey
+
+    keys = _DUMMY_TS_CACHE.get(k)
+    if keys is None:
+        keys = [TsPublicKey(bls.G1_GEN) for _ in range(k)]
+        _DUMMY_TS_CACHE[k] = keys
+    return keys

@@ -53,6 +53,8 @@ class RpcService:
         # poll-based filter registry (eth_newFilter family)
         self._filters: Dict[str, dict] = {}
         self._filter_seq = 0
+        # fe_unlock session window (reference FrontEndService wallet lock)
+        self._unlocked_until: Optional[float] = None
 
     # -- helpers ------------------------------------------------------------
 
@@ -601,13 +603,488 @@ class RpcService:
             )
         )
 
+    # -- eth_* mining/uncle/compiler surface ---------------------------------
+    # HoneyBadgerBFT has no miners, uncles or PoW; these answer with the
+    # no-such-concept values the reference returns so Web3 clients keep
+    # working (BlockchainServiceWeb3.cs mining/uncle stubs).
+
+    def eth_coinbase(self):
+        return _h(self.node.address20)
+
+    def eth_mining(self):
+        return False
+
+    def eth_hashrate(self):
+        return "0x0"
+
+    def eth_getWork(self):
+        raise JsonRpcError(-32601, "no proof-of-work on this chain")
+
+    def eth_submitWork(self, *_args):
+        return False
+
+    def eth_submitHashrate(self, *_args):
+        return False
+
+    def eth_getCompilers(self):
+        return []
+
+    def eth_compileLLL(self, *_args):
+        raise JsonRpcError(-32601, "no on-node compilers")
+
+    def eth_compileSerpent(self, *_args):
+        raise JsonRpcError(-32601, "no on-node compilers")
+
+    def eth_compileSolidity(self, *_args):
+        raise JsonRpcError(-32601, "no on-node compilers")
+
+    def eth_getUncleByBlockHashAndIndex(self, *_args):
+        return None
+
+    def eth_getUncleByBlockNumberAndIndex(self, *_args):
+        return None
+
+    # -- eth_* signing/sending via the node wallet ---------------------------
+
+    def _wallet_key(self) -> bytes:
+        self._require_unlocked()
+        return self.node.wallet.ecdsa_priv
+
+    def _eth_sign_digest(self, message: bytes) -> bytes:
+        from ..crypto.hashes import keccak256
+
+        prefix = b"\x19LACHAIN Signed Message:\n" + str(
+            len(message)
+        ).encode()
+        return keccak256(prefix + message)
+
+    def eth_sign(self, address, data):
+        if _bytes(address) != self.node.address20:
+            raise JsonRpcError(-32000, "unknown account")
+        sig = ecdsa.sign_hash(
+            self._wallet_key(), self._eth_sign_digest(_bytes(data))
+        )
+        return _h(sig)
+
+    def _build_tx(self, tx: dict) -> "SignedTransaction":
+        from ..core.types import Transaction, sign_transaction
+
+        sender = (
+            _bytes(tx["from"]) if tx.get("from") else self.node.address20
+        )
+        if sender != self.node.address20:
+            raise JsonRpcError(-32000, "unknown account")
+        nonce = (
+            _unhex(tx["nonce"])
+            if tx.get("nonce") is not None
+            else self.node.pool.next_nonce(sender)
+        )
+        t = Transaction(
+            to=_bytes(tx["to"]) if tx.get("to") else b"\x00" * 20,
+            value=_unhex(tx.get("value", "0x0")),
+            nonce=nonce,
+            gas_price=_unhex(tx.get("gasPrice", "0x1")),
+            gas_limit=_unhex(tx.get("gas", hex(10_000_000))),
+            invocation=_bytes(tx["data"]) if tx.get("data") else b"",
+        )
+        return sign_transaction(t, self._wallet_key(), self.node.chain_id)
+
+    def eth_signTransaction(self, tx):
+        return _h(self._build_tx(tx).encode())
+
+    def eth_sendTransaction(self, tx):
+        stx = self._build_tx(tx)
+        if not self.node.submit_tx(stx):
+            raise JsonRpcError(-32000, "transaction rejected by pool")
+        return _h(stx.hash())
+
+    def eth_verifyRawTransaction(self, raw):
+        try:
+            stx = SignedTransaction.decode(_bytes(raw))
+        except Exception:
+            raise JsonRpcError(-32602, "undecodable transaction")
+        sender = stx.sender(self.node.chain_id)
+        if sender is None:
+            return {"valid": False, "reason": "bad signature"}
+        return {
+            "valid": True,
+            "hash": _h(stx.hash()),
+            "from": _h(sender),
+        }
+
+    def eth_invokeContract(self, call, tag=None):
+        return self.eth_call(call, tag)
+
+    # -- eth_* pool/tx breadth ----------------------------------------------
+
+    def eth_getTransactionPool(self):
+        return sorted(_h(h) for h in self.node.pool.tx_hashes())
+
+    def eth_getTransactionPoolByHash(self, tx_hash):
+        stx = self.node.pool.get(_bytes(tx_hash))
+        return self._tx_json(stx, None, 0) if stx is not None else None
+
+    def eth_getTransactionsByBlockHash(self, block_hash):
+        block = self.node.block_manager.block_by_hash(_bytes(block_hash))
+        if block is None:
+            return []
+        out = []
+        for i, th in enumerate(block.tx_hashes):
+            stx = self.node.block_manager.transaction_by_hash(th)
+            if stx is not None:
+                out.append(self._tx_json(stx, block, i))
+        return out
+
+    def eth_getEventsByTransactionHash(self, tx_hash):
+        return self._logs_for_tx(_bytes(tx_hash))
+
+    # -- la_* raw blocks / batches / validators / trie -----------------------
+
+    def la_getBlockRawByNumber(self, number):
+        block = self.node.block_manager.block_by_height(_unhex(number))
+        return _h(block.encode()) if block else None
+
+    def la_getBlockRawByNumberBatch(self, numbers):
+        out = {}
+        for number in numbers[:1000]:
+            block = self.node.block_manager.block_by_height(_unhex(number))
+            if block is not None:
+                out[_hex(_unhex(number))] = _h(block.encode())
+        return out
+
+    def la_sendRawTransactionBatch(self, raws):
+        if len(raws) > 10_000:
+            raise JsonRpcError(-32602, "batch too large (max 10000)")
+        results = []
+        for raw in raws:
+            try:
+                results.append(self.eth_sendRawTransaction(raw))
+            except JsonRpcError as exc:
+                results.append({"error": exc.message})
+        return results
+
+    def la_sendRawTransactionBatchParallel(self, raws):
+        # ingest already batches ECDSA recovery across the whole batch
+        # (pool warm_sender_caches); parallel == batch here
+        return self.la_sendRawTransactionBatch(raws)
+
+    def la_getLatestValidators(self):
+        return [
+            _h(pk) for pk in self.node.public_keys.ecdsa_pub_keys
+        ]
+
+    def la_getValidatorsAfterBlock(self, height):
+        keys = self.node.validator_manager.keys_for_era(_unhex(height) + 1)
+        return [_h(pk) for pk in keys.ecdsa_pub_keys]
+
+    def la_getRootHashByTrieName(self, trie):
+        import dataclasses
+
+        roots = self.node.state.committed
+        name = str(trie).lower()
+        if name not in {f.name for f in dataclasses.fields(roots)}:
+            raise JsonRpcError(-32602, f"unknown trie {trie!r}")
+        return _h(getattr(roots, name))
+
+    def la_getStateHashFromTrieRoots(self, height):
+        roots = self.node.state.roots_at(_unhex(height))
+        if roots is None:
+            return None
+        return {
+            "stateHash": _h(roots.state_hash()),
+            "roots": {
+                k: _h(getattr(roots, k))
+                for k in (
+                    "balances",
+                    "contracts",
+                    "storage",
+                    "transactions",
+                    "blocks",
+                    "events",
+                    "validators",
+                )
+            },
+        }
+
+    def la_getStateHashFromTrieRootsRange(self, first, last):
+        lo, hi = _unhex(first), _unhex(last)
+        if hi - lo > 1000:
+            raise JsonRpcError(-32602, "range too large (max 1000)")
+        out = {}
+        for h in range(lo, hi + 1):
+            entry = self.la_getStateHashFromTrieRoots(_hex(h))
+            if entry is not None:
+                out[_hex(h)] = entry["stateHash"]
+        return out
+
+    def la_getNodeByHash(self, node_hash):
+        from ..storage.kv import EntryPrefix, prefixed
+
+        enc = self.node.kv.get(
+            prefixed(EntryPrefix.TRIE_NODE, _bytes(node_hash))
+        )
+        return _h(enc) if enc is not None else None
+
+    def la_getNodeByHashBatch(self, hashes):
+        out = {}
+        for h in hashes[:1000]:
+            enc = self.la_getNodeByHash(h)
+            if enc is not None:
+                out[h] = enc
+        return out
+
+    def la_getChildrenByHash(self, node_hash):
+        from ..storage import trie as _trie
+
+        raw = self.la_getNodeByHash(node_hash)
+        if raw is None:
+            return None
+        node = _trie._decode(_bytes(raw))
+        children = getattr(node, "children", None) or ()
+        return [_h(c) for c in children if c and c != _trie.EMPTY_ROOT]
+
+    def la_checkNodeHashes(self, hashes):
+        """Which of the given trie nodes this node can serve (fast-sync
+        probe; reference la_checkNodeHashes)."""
+        return {
+            h: self.la_getNodeByHash(h) is not None for h in hashes[:1000]
+        }
+
+    # -- la_* staking tx builders (reference TransactionServiceWeb3 la_get*
+    #    StakeTransaction family: unsigned txs a frontend signs itself) ------
+
+    def _staking_tx_json(self, invocation: bytes, value: int, sender: bytes):
+        from ..core import system_contracts as sc
+
+        return {
+            "from": _h(sender),
+            "to": _h(sc.STAKING_ADDRESS),
+            "value": _hex(value),
+            "gas": _hex(10_000_000),
+            "gasPrice": _hex(max(self.node.pool.min_gas_price, 1)),
+            "nonce": _hex(self.node.pool.next_nonce(sender)),
+            "data": _h(invocation),
+        }
+
+    def la_getStakeTransaction(self, address, amount, public_key=None):
+        from ..core import system_contracts as sc
+        from ..utils.serialization import write_bytes, write_u256
+
+        sender = _bytes(address)
+        if public_key is not None:
+            pub = _bytes(public_key)
+        elif sender == self.node.address20:
+            pub = self.node.wallet.public_key
+        else:
+            raise JsonRpcError(
+                -32602,
+                "publicKey required when building a stake tx for a foreign "
+                "address (the staking contract registers the 33-byte ECDSA "
+                "pubkey)",
+            )
+        if len(pub) != 33:
+            raise JsonRpcError(-32602, "publicKey must be 33 bytes")
+        inv = sc.SEL_BECOME_STAKER + write_bytes(pub) + write_u256(
+            _unhex(amount)
+        )
+        return self._staking_tx_json(inv, 0, sender)
+
+    def la_getRequestStakeWithdrawalTransaction(self, address):
+        from ..core import system_contracts as sc
+
+        sender = _bytes(address)
+        return self._staking_tx_json(sc.SEL_REQUEST_WITHDRAW, 0, sender)
+
+    def la_getWithdrawStakeTransaction(self, address):
+        from ..core import system_contracts as sc
+
+        sender = _bytes(address)
+        return self._staking_tx_json(sc.SEL_WITHDRAW, 0, sender)
+
+    # -- validator_* operator verbs ------------------------------------------
+
+    def validator_start(self):
+        """Begin staking with the node's balance net of the tx fee
+        (reference ValidatorServiceWeb3 validator_start). Moves funds, so
+        it honors the fe_unlock wallet lock like every signing RPC."""
+        self._require_unlocked()
+        snap = self._snap()
+        bal = execution.get_balance(snap, self.node.address20)
+        # the base fee is deducted before the staking handler runs
+        # (execution.py): staking the full balance would always fail
+        stake = bal - execution.GAS_PER_TX * max(
+            self.node.pool.min_gas_price, 1
+        )
+        if stake <= 0:
+            raise JsonRpcError(-32000, "no balance to stake")
+        self.node.validator_status.become_staker(stake)
+        return "ok"
+
+    def validator_start_with_stake(self, amount):
+        self._require_unlocked()
+        self.node.validator_status.become_staker(_unhex(amount))
+        return "ok"
+
+    def validator_stop(self):
+        self._require_unlocked()
+        self.node.validator_status.request_withdrawal()
+        return "ok"
+
+    # -- net_* / bcn_* -------------------------------------------------------
+
+    def net_peers(self):
+        return [
+            _h(pk) for pk in self.node.synchronizer.peer_heights.keys()
+        ]
+
+    def bcn_validators(self):
+        return self.la_getLatestValidators()
+
+    def bcn_cycle(self):
+        from ..core import system_contracts as sc
+
+        height = self.node.block_manager.current_height()
+        return {
+            "cycle": _hex(height // sc.CYCLE_DURATION),
+            "height": _hex(height),
+            "cycleDuration": _hex(sc.CYCLE_DURATION),
+        }
+
+    def bcn_syncing(self):
+        return self.eth_syncing()
+
+    # -- fe_* frontend flows (reference FrontEndService.cs:1-459) ------------
+
+    def _require_unlocked(self) -> None:
+        import time
+
+        if self._unlocked_until is not None and time.time() < self._unlocked_until:
+            return
+        if self.node.wallet._password == "":
+            return  # passwordless wallet is never locked
+        raise JsonRpcError(-32000, "wallet is locked (fe_unlock first)")
+
+    def fe_account(self):
+        snap = self._snap()
+        addr = self.node.address20
+        return {
+            "address": _h(addr),
+            "publicKey": _h(self.node.wallet.public_key),
+            "balance": _hex(execution.get_balance(snap, addr)),
+            "nonce": _hex(execution.get_nonce(snap, addr)),
+            "isValidator": self.node.index >= 0,
+        }
+
+    def fe_isLocked(self):
+        try:
+            self._require_unlocked()
+            return False
+        except JsonRpcError:
+            return True
+
+    def fe_unlock(self, password, seconds="0x12c"):
+        import time
+
+        if password != self.node.wallet._password:
+            return False
+        self._unlocked_until = time.time() + min(_unhex(seconds), 86400)
+        return True
+
+    def fe_changePassword(self, current, new):
+        if current != self.node.wallet._password:
+            return False
+        self.node.wallet.set_password(new)
+        if self.node.wallet.path:
+            self.node.wallet.save()
+        return True
+
+    def fe_sendTransaction(self, tx):
+        return self.eth_sendTransaction(tx)
+
+    def fe_verifyRawTransaction(self, raw):
+        return self.eth_verifyRawTransaction(raw)
+
+    def fe_signMessage(self, message):
+        sig = ecdsa.sign_hash(
+            self._wallet_key(), self._eth_sign_digest(_bytes(message))
+        )
+        return _h(sig)
+
+    def fe_verifySign(self, message, signature, address=None):
+        digest = self._eth_sign_digest(_bytes(message))
+        pub = ecdsa.recover_hash(digest, _bytes(signature))
+        if pub is None:
+            return {"valid": False}
+        rec = ecdsa.address_from_public_key(pub)
+        want = _bytes(address) if address else self.node.address20
+        return {"valid": rec == want, "address": _h(rec)}
+
+    def fe_pendingTransactions(self, address=None):
+        addr = _bytes(address) if address else self.node.address20
+        out = []
+        for h in self.node.pool.tx_hashes():
+            stx = self.node.pool.get(h)
+            if stx is None:
+                continue
+            sender = stx.sender(self.node.chain_id)
+            if sender == addr or stx.tx.to == addr:
+                out.append(self._tx_json(stx, None, 0))
+        return out
+
+    def fe_phase(self):
+        """Where the current cycle stands (vrf submission / attendance
+        detection / keygen windows — reference StakingContract phase
+        constants, StakingContract.cs:63-71)."""
+        from ..core import system_contracts as sc
+
+        height = self.node.block_manager.current_height()
+        pos = height % sc.CYCLE_DURATION
+        if pos < sc.ATTENDANCE_DETECTION_DURATION:
+            phase = "attendanceSubmission"
+        elif pos < sc.VRF_SUBMISSION_PHASE:
+            phase = "vrfSubmission"
+        else:
+            phase = "open"
+        return {
+            "height": _hex(height),
+            "cycle": _hex(height // sc.CYCLE_DURATION),
+            "positionInCycle": _hex(pos),
+            "phase": phase,
+        }
+
+    def fe_transactions(self, address=None, limit="0x32", before=None):
+        addr = address if address else _h(self.node.address20)
+        return self.fe_getTransactionsByAddress(addr, limit, before)
+
+    def fe_larcHistory(self, address=None, limit="0x32"):
+        """LRC-20 transfer history for an address, from the event logs of
+        the native-token contract (reference fe_larcHistory)."""
+        from ..core import system_contracts as sc
+
+        addr = _bytes(address) if address else self.node.address20
+        n = min(_unhex(limit), 1000)
+        bm = self.node.block_manager
+        out = []
+        for height, th in bm.transactions_by_address(addr, limit=n):
+            for log in self._logs_for_tx(th):
+                if _bytes(log["address"]) != sc.NATIVE_TOKEN_ADDRESS:
+                    continue
+                out.append(
+                    {
+                        "txHash": _h(th),
+                        "blockNumber": _hex(height),
+                        "data": log["data"],
+                    }
+                )
+        return out
+
     # -- registry ------------------------------------------------------------
 
     def methods(self) -> Dict[str, Any]:
         out = {}
         for name in dir(self):
             if name.startswith(
-                ("eth_", "net_", "web3_", "la_", "validator_", "fe_")
+                ("eth_", "net_", "web3_", "la_", "validator_", "fe_", "bcn_")
             ):
                 out[name] = getattr(self, name)
         return out

@@ -1,0 +1,259 @@
+"""RPC breadth (VERDICT r3 item #4): the fe_* frontend family, wallet
+flows, raw-block/batch/trie la_* methods, validator operator verbs, and the
+no-such-concept eth_* stubs — with the total method count at reference
+parity class (>= 80 of the reference's 107 JsonRpcMethods).
+
+Reference surfaces: FrontEndService.cs:1-459, BlockchainServiceWeb3.cs,
+TransactionServiceWeb3.cs, AccountServiceWeb3.cs, ValidatorServiceWeb3.cs,
+NodeService.cs.
+"""
+import asyncio
+import random
+
+import pytest
+
+from lachain_tpu.consensus.keys import trusted_key_gen
+from lachain_tpu.core import system_contracts as sc
+from lachain_tpu.core.node import Node
+from lachain_tpu.core.types import (
+    Block,
+    BlockHeader,
+    MultiSig,
+    SignedTransaction,
+    Transaction,
+    sign_transaction,
+    tx_merkle_root,
+)
+from lachain_tpu.core.vault import PrivateWallet
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.rpc.service import JsonRpcError, RpcService
+from lachain_tpu.utils.serialization import write_u256
+
+CHAIN = 421
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+@pytest.fixture
+def chain():
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(3))
+    user = ecdsa.generate_private_key(Rng(9))
+    uaddr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(user))
+    wallet = PrivateWallet(ecdsa_priv=privs[0].ecdsa_priv)
+    waddr = ecdsa.address_from_public_key(wallet.public_key)
+
+    async def build():
+        return Node(
+            index=0,
+            public_keys=pub,
+            private_keys=privs[0],
+            chain_id=CHAIN,
+            initial_balances={uaddr: 10**24, waddr: 10**24},
+            wallet=wallet,
+        )
+
+    node = asyncio.run(build())
+
+    def produce(txs):
+        bm = node.block_manager
+        txs = bm.order_transactions(txs, CHAIN)
+        height = bm.current_height() + 1
+        em = bm.emulate(txs, height)
+        prev = bm.block_by_height(height - 1)
+        header = BlockHeader(
+            index=height,
+            prev_block_hash=prev.hash(),
+            merkle_root=tx_merkle_root([t.hash() for t in txs]),
+            state_hash=em.state_hash,
+            nonce=height,
+        )
+        return bm.execute_block(header, txs, MultiSig(()))
+
+    return node, user, uaddr, produce
+
+
+def _transfer_tx(user, nonce):
+    return sign_transaction(
+        Transaction(
+            to=sc.NATIVE_TOKEN_ADDRESS,
+            value=0,
+            nonce=nonce,
+            gas_price=1,
+            gas_limit=10**7,
+            invocation=sc.SEL_TRANSFER + b"\x05" * 20 + write_u256(7),
+        ),
+        user,
+        CHAIN,
+    )
+
+
+def test_method_count_at_parity_class(chain):
+    node, *_ = chain
+    svc = RpcService(node)
+    names = svc.methods()
+    assert len(names) >= 80, sorted(names)
+    # every family is represented
+    for prefix in ("eth_", "net_", "web3_", "la_", "validator_", "fe_", "bcn_"):
+        assert any(n.startswith(prefix) for n in names), prefix
+
+
+def test_no_such_concept_stubs(chain):
+    node, *_ = chain
+    svc = RpcService(node)
+    assert svc.eth_mining() is False
+    assert svc.eth_hashrate() == "0x0"
+    assert svc.eth_getCompilers() == []
+    assert svc.eth_getUncleByBlockHashAndIndex("0x" + "00" * 32, "0x0") is None
+    assert svc.eth_getUncleByBlockNumberAndIndex("0x0", "0x0") is None
+    assert svc.eth_submitWork("0x0", "0x0", "0x0") is False
+    assert svc.eth_coinbase() == "0x" + node.address20.hex()
+    with pytest.raises(JsonRpcError):
+        svc.eth_getWork()
+    with pytest.raises(JsonRpcError):
+        svc.eth_compileSolidity("contract X {}")
+
+
+def test_wallet_sign_send_and_lock_flow(chain):
+    node, *_ = chain
+    svc = RpcService(node)
+    me = "0x" + node.address20.hex()
+
+    # passwordless wallet: never locked
+    assert svc.fe_isLocked() is False
+    sig = svc.eth_sign(me, "0x11223344")
+    check = svc.fe_verifySign("0x11223344", sig)
+    assert check["valid"] is True and check["address"] == me
+
+    # sendTransaction lands in the pool and is visible through pool RPCs
+    txh = svc.eth_sendTransaction({"to": "0x" + "07" * 20, "value": "0x5"})
+    assert txh in svc.eth_getTransactionPool()
+    assert svc.eth_getTransactionPoolByHash(txh)["hash"] == txh
+    pend = svc.fe_pendingTransactions()
+    assert any(t["hash"] == txh for t in pend)
+
+    # signTransaction returns a decodable raw tx that verifies
+    raw = svc.eth_signTransaction(
+        {"to": "0x" + "08" * 20, "value": "0x1", "nonce": "0x63"}
+    )
+    ver = svc.eth_verifyRawTransaction(raw)
+    assert ver["valid"] is True and ver["from"] == me
+    assert SignedTransaction.decode(bytes.fromhex(raw[2:])).tx.nonce == 0x63
+
+    # locked wallet: signing requires fe_unlock with the right password
+    node.wallet.set_password("hunter2")
+    assert svc.fe_isLocked() is True
+    with pytest.raises(JsonRpcError):
+        svc.eth_sign(me, "0x00")
+    assert svc.fe_unlock("wrong") is False
+    assert svc.fe_unlock("hunter2") is True
+    assert svc.fe_isLocked() is False
+    svc.eth_sign(me, "0x00")
+    # password rotation
+    assert svc.fe_changePassword("hunter2", "s3cret") is True
+    assert svc.fe_changePassword("hunter2", "x") is False
+    node.wallet.set_password("")  # restore for other assertions
+
+
+def test_raw_blocks_and_batches(chain):
+    node, user, uaddr, produce = chain
+    svc = RpcService(node)
+    produce([_transfer_tx(user, 0)])
+    raw = svc.la_getBlockRawByNumber("0x1")
+    block = Block.decode(bytes.fromhex(raw[2:]))
+    assert block.header.index == 1
+    batch = svc.la_getBlockRawByNumberBatch(["0x0", "0x1", "0x5"])
+    assert set(batch) == {"0x0", "0x1"}
+
+    stx = _transfer_tx(user, 1)
+    out = svc.la_sendRawTransactionBatch(["0x" + stx.encode().hex()])
+    assert out == ["0x" + stx.hash().hex()]
+    # a second batch submit of the same tx reports the pool rejection
+    out2 = svc.la_sendRawTransactionBatchParallel(["0x" + stx.encode().hex()])
+    assert "error" in out2[0]
+
+
+def test_validator_and_trie_surface(chain):
+    node, user, uaddr, produce = chain
+    svc = RpcService(node)
+    produce([_transfer_tx(user, 0)])
+
+    vals = svc.la_getLatestValidators()
+    assert len(vals) == 4
+    assert svc.bcn_validators() == vals
+    assert len(svc.la_getValidatorsAfterBlock("0x0")) == 4
+
+    root = svc.la_getRootHashByTrieName("balances")
+    assert root.startswith("0x") and len(root) == 66
+    with pytest.raises(JsonRpcError):
+        svc.la_getRootHashByTrieName("nope")
+
+    # the committed state hash recomputes from the per-trie roots
+    sh = svc.la_getStateHashFromTrieRoots("0x1")
+    assert (
+        sh["stateHash"]
+        == "0x" + node.block_manager.block_by_height(1).header.state_hash.hex()
+    )
+    rng = svc.la_getStateHashFromTrieRootsRange("0x0", "0x1")
+    assert rng["0x1"] == sh["stateHash"]
+
+    # trie nodes are servable by hash (the fast-sync serve side over RPC)
+    enc = svc.la_getNodeByHash(root)
+    assert enc is not None
+    assert svc.la_checkNodeHashes([root, "0x" + "ee" * 32]) == {
+        root: True,
+        "0x" + "ee" * 32: False,
+    }
+    children = svc.la_getChildrenByHash(root)
+    assert children is None or isinstance(children, list)
+
+    # staking tx builders
+    stake = svc.la_getStakeTransaction("0x" + node.address20.hex(), "0x64")
+    assert stake["to"] == "0x" + sc.STAKING_ADDRESS.hex()
+    assert stake["data"].startswith("0x" + sc.SEL_BECOME_STAKER.hex())
+    assert svc.la_getRequestStakeWithdrawalTransaction(
+        "0x" + node.address20.hex()
+    )["data"] == "0x" + sc.SEL_REQUEST_WITHDRAW.hex()
+    assert svc.la_getWithdrawStakeTransaction("0x" + node.address20.hex())[
+        "data"
+    ] == "0x" + sc.SEL_WITHDRAW.hex()
+
+    # operator verbs drive the ValidatorStatusManager -> staking tx in pool
+    before = len(svc.eth_getTransactionPool())
+    assert svc.validator_start_with_stake("0x64") == "ok"
+    assert len(svc.eth_getTransactionPool()) == before + 1
+    assert svc.validator_stop() == "ok"
+
+
+def test_frontend_account_phase_history(chain):
+    node, user, uaddr, produce = chain
+    svc = RpcService(node)
+    produce([_transfer_tx(user, 0)])
+
+    acct = svc.fe_account()
+    assert acct["address"] == "0x" + node.address20.hex()
+    assert int(acct["balance"], 16) > 0
+    assert acct["isValidator"] is True
+
+    phase = svc.fe_phase()
+    assert phase["phase"] in ("attendanceSubmission", "vrfSubmission", "open")
+    assert int(phase["cycle"], 16) == 0
+    cyc = svc.bcn_cycle()
+    assert int(cyc["cycleDuration"], 16) == sc.CYCLE_DURATION
+    assert svc.bcn_syncing() == svc.eth_syncing()
+    assert svc.net_peers() == []
+
+    # tx + event breadth for the produced transfer
+    bh = svc.eth_getBlockByNumber("0x1")["hash"]
+    txs = svc.eth_getTransactionsByBlockHash(bh)
+    assert len(txs) == 1
+    events = svc.eth_getEventsByTransactionHash(txs[0]["hash"])
+    assert len(events) >= 1
+    hist = svc.fe_larcHistory("0x" + uaddr.hex())
+    assert len(hist) >= 1 and hist[0]["txHash"] == txs[0]["hash"]
+    assert svc.fe_transactions("0x" + uaddr.hex())

@@ -1,0 +1,29 @@
+"""Kernel warmup (crypto/warmup.py): precompiles every reachable era shape."""
+import pytest
+
+from lachain_tpu.crypto.warmup import era_warmup_shapes, warmup_era_kernels
+
+
+def test_shapes_largest_first():
+    assert era_warmup_shapes(16) == [16, 8, 4, 2, 1]
+    assert era_warmup_shapes(5) == [8, 4, 2, 1]
+
+
+def test_warmup_runs_every_shape_through_backend():
+    from lachain_tpu.crypto.tpu_backend import TpuBackend
+
+    backend = TpuBackend(min_device_lanes=1)
+    t = warmup_era_kernels(4, backend=backend, include_ts=True)
+    assert t is not None
+    t.join(timeout=600)
+    assert not t.is_alive()
+    assert backend.era_calls == len(era_warmup_shapes(4))
+    # the coin/G2 kernel path warmed too (regression: passing TPKE
+    # verification keys here raised AttributeError and silently skipped it)
+    assert backend.ts_era_calls >= 1
+
+
+def test_warmup_noop_on_host_backend():
+    from lachain_tpu.crypto.provider import PythonBackend
+
+    assert warmup_era_kernels(4, backend=PythonBackend()) is None
