@@ -32,6 +32,17 @@ class ValidatorAttendance:
             return self._next.get(public_key, 0)
         return 0
 
+    def counts_for(self, cycle: int) -> Dict[bytes, int]:
+        """All recorded per-validator counts for `cycle` — keyed by whoever
+        actually co-signed, NOT by any particular era's validator set, so a
+        rotated-out validator's attendance still reaches the detection
+        report."""
+        if cycle == self.previous_cycle:
+            return dict(self._previous)
+        if cycle == self.next_cycle:
+            return dict(self._next)
+        return {}
+
     def increment(self, public_key: bytes, cycle: int) -> None:
         if cycle == self.previous_cycle:
             self._previous[public_key] = self._previous.get(public_key, 0) + 1

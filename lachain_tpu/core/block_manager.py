@@ -234,14 +234,23 @@ class BlockManager:
 
     # -- genesis ---------------------------------------------------------------
     def build_genesis(
-        self, initial_balances: Dict[bytes, int], chain_id: int
+        self,
+        initial_balances: Dict[bytes, int],
+        chain_id: int,
+        validator_pubs: Optional[List[bytes]] = None,
     ) -> Block:
-        """Reference: GenesisBuilder.cs:14-76 — block 0 with funded accounts."""
+        """Reference: GenesisBuilder.cs:14-76 — block 0 with funded accounts
+        and the genesis validator set registered with the staking contract
+        (the attendance-detection electorate)."""
         if self.block_by_height(0) is not None:
             return self.block_by_height(0)
         snap = self.state.new_snapshot(StateRoots())
         for addr, bal in sorted(initial_balances.items()):
             set_balance(snap, addr, bal)
+        if validator_pubs:
+            from . import system_contracts as _sc
+
+            _sc.register_genesis_validators(snap, list(validator_pubs))
         roots = snap.freeze()
         header = BlockHeader(
             index=0,

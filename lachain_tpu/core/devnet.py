@@ -74,7 +74,11 @@ class Devnet:
             # so the devnet exercises the same execution surface as a real node
             executer = system_contracts.make_executer(chain_id)
             bm = BlockManager(kv, state, executer)
-            bm.build_genesis(self.initial_balances, chain_id)
+            bm.build_genesis(
+                self.initial_balances,
+                chain_id,
+                validator_pubs=list(self.public_keys.ecdsa_pub_keys),
+            )
             pool = TransactionPool(
                 kv,
                 chain_id,

@@ -79,7 +79,11 @@ class Node:
             self.state,
             executer or system_contracts.make_executer(chain_id),
         )
-        self.block_manager.build_genesis(dict(initial_balances or {}), chain_id)
+        self.block_manager.build_genesis(
+            dict(initial_balances or {}),
+            chain_id,
+            validator_pubs=list(public_keys.ecdsa_pub_keys),
+        )
         self.pool = TransactionPool(
             self.kv, chain_id, account_nonce=self._account_nonce
         )
@@ -137,7 +141,14 @@ class Node:
             kv=self.kv,
         )
         self.validator_status = ValidatorStatusManager(
-            private_keys.ecdsa_priv, self._send_system_tx
+            private_keys.ecdsa_priv,
+            self._send_system_tx,
+            # everyone who co-signed during that cycle — keyed by recorded
+            # pubkeys, not the CURRENT set, so rotated-out validators'
+            # attendance still gets reported
+            attendance_reader=lambda cycle: self.attendance.counts_for(
+                cycle
+            ),
         )
         # per-cycle signed-header attendance, durable across restarts
         # (reference: ValidatorAttendance persisted from RootProtocol

@@ -35,6 +35,11 @@ STAKING_ADDRESS = b"\x00" * 19 + b"\x03"
 CYCLE_DURATION = 1000  # blocks per validator cycle
 VRF_SUBMISSION_PHASE = 500  # blocks of the cycle accepting VRF submissions
 ATTENDANCE_DETECTION_DURATION = 100
+# per-cycle reward pool distributed by attendance (reference
+# DistributeRewardsAndPenalties' totalReward; a validator that skips the
+# detection check-in forfeits its share AND accrues that much penalty
+# against its stake, StakingContract.cs:656-720)
+ATTENDANCE_CYCLE_REWARD = 1000 * 10**18
 
 
 def set_cycle_params(
@@ -72,6 +77,9 @@ SEL_KEYGEN_SEND_VALUE = selector("keygenSendValue(uint256,bytes)")
 SEL_KEYGEN_CONFIRM = selector("keygenConfirm(bytes)")
 SEL_CHANGE_VALIDATORS = selector("changeValidators(bytes)")
 SEL_FINISH_CYCLE = selector("finishCycle()")
+SEL_SUBMIT_ATTENDANCE = selector("submitAttendanceDetection(bytes[],uint256[])")
+SEL_FINISH_ATTENDANCE = selector("finishAttendanceDetection()")
+SEL_GET_PENALTY = selector("getPenalty(address)")
 
 
 def _skey(contract: bytes, key: bytes) -> bytes:
@@ -227,6 +235,23 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
         pay = min(amount, stake_amount)
         if pay == 0:
             return 0, b""
+        # accrued attendance penalties burn out of the unstaked amount
+        # first (reference deducts _stakedAddressToPenalty from the
+        # withdrawal, StakingContract.cs:396-448): the full `pay` leaves the
+        # stake, only `credit` reaches the balance, `burn` is destroyed
+        pen_key = b"penalty:" + ctx.sender
+        penalty = int.from_bytes(ctx.sget(STAKING_ADDRESS, pen_key) or b"", "big")
+        burn = min(penalty, pay)
+        credit = pay - burn
+        if burn:
+            penalty -= burn
+            if penalty:
+                ctx.sput(STAKING_ADDRESS, pen_key, write_u256(penalty))
+            else:
+                ctx.sdel(STAKING_ADDRESS, pen_key)
+            ctx.emit(
+                STAKING_ADDRESS, b"penalty_burned" + ctx.sender + write_u256(burn)
+            )
         ctx.sput(STAKING_ADDRESS, b"stake:" + ctx.sender, write_u256(stake_amount - pay))
         ctx.sdel(STAKING_ADDRESS, b"withdraw:" + ctx.sender)
         total = int.from_bytes(ctx.sget(STAKING_ADDRESS, b"total") or b"", "big") if ctx.sget(STAKING_ADDRESS, b"total") else 0
@@ -234,10 +259,134 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
         execution.set_balance(
             ctx.snap,
             ctx.sender,
-            execution.get_balance(ctx.snap, ctx.sender) + pay,
+            execution.get_balance(ctx.snap, ctx.sender) + credit,
         )
-        ctx.emit(STAKING_ADDRESS, b"withdrawn" + ctx.sender + write_u256(pay))
+        ctx.emit(STAKING_ADDRESS, b"withdrawn" + ctx.sender + write_u256(credit))
         return 1, b""
+
+    if sel == SEL_SUBMIT_ATTENDANCE:
+        # (reference SubmitAttendanceDetection, StakingContract.cs:538-634):
+        # during the first ATTENDANCE_DETECTION_DURATION blocks of a cycle,
+        # each previous-cycle validator reports how many blocks it saw every
+        # previous-cycle validator co-sign. Reports are votes; the median is
+        # taken at finishAttendanceDetection. Checking in at all is what
+        # shields a validator from the no-show penalty.
+        cycle = ctx.block // CYCLE_DURATION
+        if cycle == 0 or ctx.block % CYCLE_DURATION >= ATTENDANCE_DETECTION_DURATION:
+            return 0, b""
+        entries = args.bytes_list()
+        prev_raw = ctx.sget(STAKING_ADDRESS, b"prev_pubs")
+        prev_pubs = Reader(prev_raw).bytes_list() if prev_raw else []
+        sender_pub = ctx.sget(STAKING_ADDRESS, b"pub:" + ctx.sender)
+        if not sender_pub or sender_pub not in prev_pubs:
+            return 0, b""
+        checkin_key = b"att_checkin:" + write_u64(cycle)
+        raw = ctx.sget(STAKING_ADDRESS, checkin_key)
+        voters = Reader(raw).bytes_list() if raw else []
+        if sender_pub in voters:
+            return 0, b""
+        # validate the whole report before accepting any of it; duplicate
+        # targets are rejected — one voter gets ONE vote per validator, or
+        # a single report could stuff the median
+        parsed = []
+        seen: set = set()
+        for e in entries:
+            if len(e) != 33 + 4:
+                return 0, b""
+            pub, cnt = e[:33], int.from_bytes(e[33:], "big")
+            if pub not in prev_pubs or pub in seen or cnt > CYCLE_DURATION:
+                return 0, b""
+            seen.add(pub)
+            parsed.append((pub, cnt))
+        voters.append(sender_pub)
+        from ..utils.serialization import write_bytes_list
+
+        ctx.sput(STAKING_ADDRESS, checkin_key, write_bytes_list(voters))
+        for pub, cnt in parsed:
+            vkey = b"att_votes:" + write_u64(cycle) + pub
+            ctx.sput(
+                STAKING_ADDRESS,
+                vkey,
+                (ctx.sget(STAKING_ADDRESS, vkey) or b"") + write_u32(cnt),
+            )
+        ctx.emit(STAKING_ADDRESS, b"attendance_submitted" + sender_pub)
+        return 1, b""
+
+    if sel == SEL_FINISH_ATTENDANCE:
+        # (reference DistributeRewardsAndPenalties, StakingContract.cs:
+        # 656-720): once the detection window closes, each previous-cycle
+        # validator's reward share scales with the MEDIAN voted block count;
+        # a validator that never checked in forfeits its share and accrues
+        # it as a penalty against its stake. Idempotent per cycle; any
+        # validator may send the close tx once the window has passed (the
+        # reference injects it as a block-production system tx instead).
+        cycle = ctx.block // CYCLE_DURATION
+        if cycle == 0 or ctx.block % CYCLE_DURATION < ATTENDANCE_DETECTION_DURATION:
+            return 0, b""
+        done_key = b"att_done:" + write_u64(cycle)
+        if ctx.sget(STAKING_ADDRESS, done_key):
+            return 0, b""
+        prev_raw = ctx.sget(STAKING_ADDRESS, b"prev_pubs")
+        prev_pubs = Reader(prev_raw).bytes_list() if prev_raw else []
+        if not prev_pubs:
+            return 0, b""
+        ctx.sput(STAKING_ADDRESS, done_key, b"\x01")
+        raw = ctx.sget(STAKING_ADDRESS, b"att_checkin:" + write_u64(cycle))
+        voters = Reader(raw).bytes_list() if raw else []
+        max_share = ATTENDANCE_CYCLE_REWARD // len(prev_pubs)
+        from ..crypto.ecdsa import address_from_public_key
+
+        for pub in prev_pubs:
+            addr = address_from_public_key(pub)
+            pen_key = b"penalty:" + addr
+            penalty = int.from_bytes(
+                ctx.sget(STAKING_ADDRESS, pen_key) or b"", "big"
+            )
+            if pub not in voters:
+                penalty += max_share  # no-show: reward-sized penalty
+            vkey = b"att_votes:" + write_u64(cycle) + pub
+            votes_raw = ctx.sget(STAKING_ADDRESS, vkey) or b""
+            votes = sorted(
+                int.from_bytes(votes_raw[i : i + 4], "big")
+                for i in range(0, len(votes_raw), 4)
+            )
+            if votes:
+                mid = len(votes) // 2
+                active = (
+                    (votes[mid - 1] + votes[mid]) // 2
+                    if len(votes) % 2 == 0
+                    else votes[mid]
+                )
+            else:
+                active = 0
+            reward = max_share * active // CYCLE_DURATION
+            burn = min(penalty, reward)
+            penalty -= burn
+            reward -= burn
+            if penalty:
+                ctx.sput(STAKING_ADDRESS, pen_key, write_u256(penalty))
+            else:
+                ctx.sdel(STAKING_ADDRESS, pen_key)
+            if reward:
+                execution.set_balance(
+                    ctx.snap,
+                    addr,
+                    execution.get_balance(ctx.snap, addr) + reward,
+                )
+            ctx.sdel(STAKING_ADDRESS, vkey)
+        # settle-time cleanup (reference ClearAttendanceDetectorCheckIns):
+        # the voter list is never read again, and the previous cycle's done
+        # flag is out of every code path once this cycle settles
+        ctx.sdel(STAKING_ADDRESS, b"att_checkin:" + write_u64(cycle))
+        if cycle > 1:
+            ctx.sdel(STAKING_ADDRESS, b"att_done:" + write_u64(cycle - 1))
+        ctx.emit(STAKING_ADDRESS, b"attendance_finished" + write_u64(cycle))
+        return 1, b""
+
+    if sel == SEL_GET_PENALTY:
+        addr = args.raw(ADDRESS_BYTES)
+        raw = ctx.sget(STAKING_ADDRESS, b"penalty:" + addr)
+        return 1, raw or write_u256(0)
 
     if sel == SEL_SUBMIT_VRF:
         # (reference SubmitVrf, StakingContract.cs:458-537): within the VRF
@@ -422,8 +571,37 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
             return 0, b""
         pending = ctx.sget(GOVERNANCE_ADDRESS, b"pending_validators")
         if pending:
+            outgoing = ctx.snap.get("validators", b"current")
             ctx.snap.put("validators", b"current", pending)
             ctx.sdel(GOVERNANCE_ADDRESS, b"pending_validators")
+            # next cycle's attendance-detection electorate is the OUTGOING
+            # set — the validators who served the cycle being judged
+            # (reference captures _previousValidatorPubKeys from the
+            # pre-rotation snapshot). When the genesis set was still active
+            # (`outgoing` is None) prev_pubs already holds it. The NEW
+            # set's pub->address mappings register now so its members can
+            # submit once they become the electorate.
+            try:
+                from ..consensus.keys import PublicConsensusKeys
+                from ..crypto.ecdsa import address_from_public_key
+                from ..utils.serialization import write_bytes_list
+
+                if outgoing is not None:
+                    out_keys = PublicConsensusKeys.decode(outgoing)
+                    ctx.sput(
+                        STAKING_ADDRESS,
+                        b"prev_pubs",
+                        write_bytes_list(list(out_keys.ecdsa_pub_keys)),
+                    )
+                new_keys = PublicConsensusKeys.decode(pending)
+                for pub in new_keys.ecdsa_pub_keys:
+                    ctx.sput(
+                        STAKING_ADDRESS,
+                        b"pub:" + address_from_public_key(pub),
+                        pub,
+                    )
+            except Exception:
+                pass  # undecodable candidate cannot block the rotation
             ctx.emit(GOVERNANCE_ADDRESS, b"cycle_finished")
             return 1, b""
         return 0, b""
@@ -480,6 +658,28 @@ SYSTEM_CONTRACTS: Dict[bytes, Callable] = {
         STAKING_ADDRESS,
     )
 }
+
+
+def register_genesis_validators(snap: Snapshot, pubkeys: List[bytes]) -> None:
+    """Seed the attendance-detection electorate at genesis: the staking
+    contract's `prev_pubs` list plus the pub->address mapping for each
+    genesis validator (a rotation later overwrites both at FinishCycle).
+    Reference analogue: genesis validators enter _previousValidatorPubKeys
+    via config, config_mainnet.json validators."""
+    from ..crypto.ecdsa import address_from_public_key
+    from ..utils.serialization import write_bytes_list
+
+    snap.put(
+        "storage",
+        _skey(STAKING_ADDRESS, b"prev_pubs"),
+        write_bytes_list(list(pubkeys)),
+    )
+    for pub in pubkeys:
+        snap.put(
+            "storage",
+            _skey(STAKING_ADDRESS, b"pub:" + address_from_public_key(pub)),
+            pub,
+        )
 
 
 def make_executer(chain_id: int) -> execution.TransactionExecuter:

@@ -30,6 +30,7 @@ class ValidatorStatusManager:
         *,
         cycle_duration: Optional[int] = None,
         vrf_phase: Optional[int] = None,
+        attendance_reader: Optional[Callable[[int], dict]] = None,
     ):
         self._priv = ecdsa_priv
         self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
@@ -37,6 +38,9 @@ class ValidatorStatusManager:
         self._send_tx = send_tx
         self._cycle_duration = cycle_duration or sc.CYCLE_DURATION
         self._vrf_phase = vrf_phase or sc.VRF_SUBMISSION_PHASE
+        # attendance_reader(cycle) -> {validator_pubkey: blocks_cosigned}
+        # (the node's durable ValidatorAttendance counts)
+        self._attendance_reader = attendance_reader
         self._submitted_cycles: set = set()
         self.withdraw_requested = False
 
@@ -52,6 +56,7 @@ class ValidatorStatusManager:
     def on_block_persisted(self, block: Block, snap: Snapshot) -> None:
         height = block.header.index
         cycle = height // self._cycle_duration
+        self._attendance_detection(height, cycle, snap)
         in_phase = height % self._cycle_duration < self._vrf_phase
         if not in_phase:
             # submission phase over: close the lottery if nobody has yet
@@ -87,6 +92,57 @@ class ValidatorStatusManager:
             + write_bytes(self.public_key)
             + write_bytes(proof),
         )
+
+    def _attendance_detection(
+        self, height: int, cycle: int, snap: Snapshot
+    ) -> None:
+        """Drive the attendance-detection phase (reference: the node's
+        KeyGenManager/system-tx plumbing around
+        StakingContract.SubmitAttendanceDetection, cs:538-634):
+          * during the detection window of cycle >= 1, submit the previous
+            cycle's locally-recorded co-signing counts for every electorate
+            member — self-healing (re-offer until the on-chain check-in flag
+            for our key appears);
+          * once the window closes, offer the finish tx until the on-chain
+            done flag appears (the contract dedupes)."""
+        if cycle == 0 or self._attendance_reader is None:
+            return
+        in_window = (
+            height % self._cycle_duration < sc.ATTENDANCE_DETECTION_DURATION
+        )
+        cyc = write_u64(cycle)
+        if in_window:
+            raw = self._storage(snap, b"att_checkin:" + cyc)
+            if raw is not None and self.public_key in Reader(raw).bytes_list():
+                return  # already checked in on-chain
+            prev_raw = self._storage(snap, b"prev_pubs")
+            prev_pubs = Reader(prev_raw).bytes_list() if prev_raw else []
+            if self.public_key not in prev_pubs:
+                return  # not in the electorate
+            counts = self._attendance_reader(cycle - 1)
+            entries = [
+                write_bytes(
+                    pub
+                    + min(
+                        counts.get(pub, 0), self._cycle_duration
+                    ).to_bytes(4, "big")
+                )
+                for pub in prev_pubs
+            ]
+            logger.info("cycle %d: submitting attendance detection", cycle)
+            self._send_tx(
+                sc.STAKING_ADDRESS,
+                sc.SEL_SUBMIT_ATTENDANCE
+                + write_u32(len(entries))
+                + b"".join(entries),
+            )
+        else:
+            if self._storage(snap, b"att_done:" + cyc) is not None:
+                return
+            if self._storage(snap, b"prev_pubs") is None:
+                return
+            logger.info("cycle %d: closing attendance detection", cycle)
+            self._send_tx(sc.STAKING_ADDRESS, sc.SEL_FINISH_ATTENDANCE + b"")
 
     def _maybe_finish_lottery(self, cycle: int, snap: Snapshot) -> None:
         # self-healing: re-offer every block until the on-chain
