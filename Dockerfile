@@ -1,0 +1,28 @@
+# lachain-tpu node image (role of the reference's Dockerfile +
+# docker-compose.4nodes.yml packaging).
+#
+# The native backends (libbls381, libconsensus_rt) compile from source on
+# first import, so the toolchain stays in the image; CPU-only JAX serves the
+# host crypto paths — on TPU VMs the baked-in jax[tpu] of the machine image
+# takes precedence (mount the site-packages or build FROM a TPU base image).
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy
+
+WORKDIR /app
+COPY lachain_tpu /app/lachain_tpu
+COPY pyproject.toml /app/
+
+# pre-build the native libraries so containers start instantly
+RUN make -s -C lachain_tpu/crypto/native && make -s -C lachain_tpu/consensus/native
+
+ENV PYTHONPATH=/app \
+    JAX_PLATFORMS=cpu \
+    LOG_LEVEL=INFO
+
+ENTRYPOINT ["python", "-m", "lachain_tpu.cli"]
+CMD ["run", "--config", "/data/config.json"]

@@ -1,0 +1,119 @@
+"""Block-commit latency at 10k txs on the durable store (VERDICT r3 #7).
+
+Drives the REAL commit path — order/emulate/execute_block with trie updates,
+receipts, blooms and the fsynced sqlite batch — for a 10,000-transfer block,
+and the raw write_batch throughput underneath it. Prints ONE JSON line.
+
+Usage: python benchmarks/bench_storage_commit.py [--txs 10000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--txs", type=int, default=10_000)
+    args = ap.parse_args()
+
+    from lachain_tpu.core import system_contracts
+    from lachain_tpu.core.block_manager import BlockManager
+    from lachain_tpu.core.types import (
+        BlockHeader,
+        MultiSig,
+        Transaction,
+        sign_transaction,
+        tx_merkle_root,
+        warm_sender_caches,
+    )
+    from lachain_tpu.crypto import ecdsa
+    from lachain_tpu.storage.kv import SqliteKV
+    from lachain_tpu.storage.state import StateManager
+
+    chain = 515
+    users = [ecdsa.generate_private_key(Rng(3 + i)) for i in range(64)]
+    addrs = [ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)) for u in users]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        kv = SqliteKV(os.path.join(tmp, "bench.db"))
+        state = StateManager(kv)
+        bm = BlockManager(kv, state, system_contracts.make_executer(chain))
+        bm.build_genesis({a: 10**24 for a in addrs}, chain)
+
+        txs = []
+        per_user = (args.txs + len(users) - 1) // len(users)
+        for u, priv in enumerate(users):
+            for n in range(per_user):
+                if len(txs) >= args.txs:
+                    break
+                txs.append(
+                    sign_transaction(
+                        Transaction(
+                            to=b"\x09" * 20,
+                            value=1,
+                            nonce=n,
+                            gas_price=1,
+                            gas_limit=21000,
+                        ),
+                        priv,
+                        chain,
+                    )
+                )
+        warm_sender_caches(txs, chain)
+
+        ordered = bm.order_transactions(txs, chain)
+        t0 = time.perf_counter()
+        em = bm.emulate(ordered, 1)
+        t_emulate = time.perf_counter() - t0
+        header = BlockHeader(
+            index=1,
+            prev_block_hash=bm.block_by_height(0).hash(),
+            merkle_root=tx_merkle_root([t.hash() for t in ordered]),
+            state_hash=em.state_hash,
+            nonce=1,
+        )
+        t0 = time.perf_counter()
+        bm.execute_block(header, ordered, MultiSig(()), check_state_hash=True)
+        t_commit = time.perf_counter() - t0
+
+        # raw fsynced batch throughput under the same store
+        payload = [(b"raw:%d" % i, b"\xab" * 256) for i in range(10_000)]
+        t0 = time.perf_counter()
+        kv.write_batch(payload)
+        t_raw = time.perf_counter() - t0
+        kv.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "block_commit_latency_s",
+                "value": round(t_commit, 3),
+                "unit": f"s per {len(txs)}-tx block commit (execute+trie+fsync)",
+                "txs": len(txs),
+                "emulate_s": round(t_emulate, 3),
+                "tx_per_s_commit": round(len(txs) / t_commit, 1),
+                "raw_batch_10k_puts_s": round(t_raw, 3),
+                "store": "SqliteKV WAL synchronous=FULL batches",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -155,6 +155,7 @@ def _build_node(cfg, config_path=None):
         kv=SqliteKV(db_path) if db_path else None,
         host=cfg.network.host,
         port=cfg.network.port,
+        advertise_host=cfg.network.advertise_host,
         initial_balances=balances,
         txs_per_block=cfg.blockchain.target_txs_per_block,
         wallet=wallet,

@@ -75,6 +75,9 @@ def migrate(cfg: dict) -> dict:
 class NetworkSection:
     host: str = "127.0.0.1"
     port: int = 7070
+    # the address OTHER nodes should dial (defaults to host; set when
+    # binding a wildcard or behind NAT in multi-host deployments)
+    advertise_host: Optional[str] = None
     # peers: list of "host:port:pubkeyhex"
     peers: List[str] = field(default_factory=list)
 
@@ -152,6 +155,7 @@ class NodeConfig:
             network=NetworkSection(
                 host=net.get("host", "127.0.0.1"),
                 port=int(net.get("port", 7070)),
+                advertise_host=net.get("advertiseHost"),
                 peers=list(net.get("peers", [])),
             ),
             genesis=GenesisSection(

@@ -65,6 +65,7 @@ class Node:
         executer: Optional[TransactionExecuter] = None,
         wallet: Optional[PrivateWallet] = None,
         block_interval: float = 0.0,
+        advertise_host: Optional[str] = None,
     ):
         self.index = index
         self.public_keys = public_keys
@@ -95,7 +96,11 @@ class Node:
             proposal_seed=max(index, 0),
         )
         self.network = NetworkManager(
-            private_keys.ecdsa_priv, host, port, flush_interval=flush_interval
+            private_keys.ecdsa_priv,
+            host,
+            port,
+            flush_interval=flush_interval,
+            advertise_host=advertise_host,
         )
         self.network.on_consensus = self._on_consensus
         self.network.on_sync_pool_reply = self._on_pool_txs

@@ -30,12 +30,23 @@ class NetworkManager:
         port: int = 0,
         *,
         flush_interval: float = 0.25,
+        advertise_host: Optional[str] = None,
     ):
+        # the address peers should DIAL — differs from the bind host when
+        # binding a wildcard (0.0.0.0) or behind NAT in multi-host deploys
+        self.advertise_host = advertise_host or host
         self.factory = MessageFactory(ecdsa_priv)
         self.public_key = self.factory.public_key
         self.hub = Hub(host, port, self._on_raw_batch)
         self._flush_interval = flush_interval
         self._workers: Dict[bytes, ClientWorker] = {}
+        # sends addressed to peers we have not discovered yet: buffered
+        # (bounded per peer) and drained the moment the address is learned —
+        # consensus protocols do not retransmit, so a message dropped during
+        # the bootstrap/discovery race can wedge an era (a lost RBC ECHO is
+        # unrecoverable for the slot)
+        self._undelivered: Dict[bytes, List[NetworkMessage]] = {}
+        self._undelivered_cap = 2048
         # event handlers: fn(sender_pubkey, message)
         self.on_consensus: Optional[Callable[[bytes, int, object], None]] = None
         self.on_ping_request: Optional[Callable[[bytes, int], None]] = None
@@ -48,6 +59,9 @@ class NetworkManager:
         self.on_sync_blocks_reply: Optional[Callable] = None
         self.on_sync_pool_request: Optional[Callable] = None
         self.on_sync_pool_reply: Optional[Callable] = None
+        # gossip peer discovery: fired when a previously-unknown peer is
+        # learned from a peers_reply (after the worker already exists)
+        self.on_peer_discovered: Optional[Callable[[PeerAddress], None]] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -63,17 +77,53 @@ class NetworkManager:
     def address(self) -> PeerAddress:
         return PeerAddress(self.public_key, self.hub.host, self.hub.port)
 
-    def add_peer(self, peer: PeerAddress) -> None:
+    def add_peer(self, peer: PeerAddress, authoritative: bool = True) -> None:
+        """Install (or update) the dialing address for a peer.
+
+        `authoritative` addresses come from config or from the peer ITSELF
+        (a peers_request rides a signature-verified batch from that pubkey)
+        and may REPLACE an existing binding — a restarted peer on a new
+        port, or a binding poisoned by bogus gossip, corrects itself the
+        moment the real peer makes contact. Third-party gossip
+        (peers_reply entries) is non-authoritative: it can only introduce
+        UNKNOWN peers, never rebind a known one, so a Byzantine address
+        book cannot blackhole traffic to a validator we already reach.
+        """
         if peer.public_key == self.public_key:
             return
-        if peer.public_key in self._workers:
-            return
+        old = self._workers.get(peer.public_key)
+        if old is not None:
+            if not authoritative or (
+                old.peer.host == peer.host and old.peer.port == peer.port
+            ):
+                return
+            # self-declared address change: rebind
+            logger.info(
+                "peer %s rebinds %s:%d -> %s:%d",
+                peer.public_key.hex()[:16],
+                old.peer.host, old.peer.port, peer.host, peer.port,
+            )
+            self._workers.pop(peer.public_key, None)
+            try:
+                asyncio.get_event_loop().create_task(old.stop())
+            except RuntimeError:  # no running loop (tests)
+                pass
         worker = ClientWorker(
             peer, self.factory, self.hub,
             flush_interval=self._flush_interval,
         )
         self._workers[peer.public_key] = worker
         worker.start()
+        # gossip crawl: ask every new acquaintance for its address book,
+        # carrying our own dialable address so it can dial back
+        # (config-seeded + gossip-learned peers; reference reaches peers
+        # through bootstrap relays, HubConnector.cs:26-105 +
+        # config_mainnet.json:22-33)
+        worker.enqueue(
+            wire.peers_request(self.advertise_host, self.hub.port)
+        )
+        for msg in self._undelivered.pop(peer.public_key, ()):
+            worker.enqueue(msg)
 
     @property
     def peers(self) -> List[bytes]:
@@ -84,7 +134,14 @@ class NetworkManager:
     def send_to(self, public_key: bytes, msg: NetworkMessage) -> None:
         worker = self._workers.get(public_key)
         if worker is None:
-            logger.warning("no worker for peer %s", public_key.hex()[:16])
+            pending = self._undelivered.setdefault(public_key, [])
+            if len(pending) < self._undelivered_cap:
+                pending.append(msg)
+            else:
+                logger.warning(
+                    "undelivered buffer full for unknown peer %s",
+                    public_key.hex()[:16],
+                )
             return
         worker.enqueue(msg)
 
@@ -140,3 +197,44 @@ class NetworkManager:
             self.on_trie_nodes_request(sender, wire.parse_trie_nodes_request(msg))
         elif k == wire.KIND_TRIE_NODES_REPLY and self.on_trie_nodes_reply:
             self.on_trie_nodes_reply(sender, wire.parse_trie_nodes_reply(msg))
+        elif k == wire.KIND_PEERS_REQUEST:
+            self._on_peers_request(sender, msg)
+        elif k == wire.KIND_PEERS_REPLY:
+            self._on_peers_reply(msg)
+
+    # -- gossip peer discovery ---------------------------------------------
+
+    def _on_peers_request(self, sender: bytes, msg: NetworkMessage) -> None:
+        host, port = wire.parse_peers_request(msg)
+        # the requester's self-declared address arrived under its own batch
+        # signature: authoritative (installs OR rebinds), so an inbound-only
+        # acquaintance gets a worker to carry the reply
+        self.add_peer(
+            PeerAddress(public_key=sender, host=host, port=port),
+            authoritative=True,
+        )
+        book = [
+            (w.peer.public_key, w.peer.host, w.peer.port)
+            for w in self._workers.values()
+            if w.peer.public_key != sender
+        ]
+        book.append((self.public_key, self.advertise_host, self.hub.port))
+        self.send_to(sender, wire.peers_reply(book))
+
+    def _on_peers_reply(self, msg: NetworkMessage) -> None:
+        try:
+            entries = wire.parse_peers_reply(msg)
+        except ValueError:
+            logger.warning("malformed peers reply dropped")
+            return
+        for pub, host, port in entries:
+            if pub == self.public_key or pub in self._workers:
+                continue
+            peer = PeerAddress(public_key=pub, host=host, port=port)
+            # third-party gossip: may only INTRODUCE unknown peers
+            self.add_peer(peer, authoritative=False)
+            if self.on_peer_discovered:
+                try:
+                    self.on_peer_discovered(peer)
+                except Exception:
+                    logger.exception("peer-discovered handler failed")

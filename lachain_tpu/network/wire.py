@@ -159,6 +159,8 @@ KIND_FAST_SYNC_REQUEST = 8
 KIND_FAST_SYNC_REPLY = 9
 KIND_TRIE_NODES_REQUEST = 10
 KIND_TRIE_NODES_REPLY = 11
+KIND_PEERS_REQUEST = 12
+KIND_PEERS_REPLY = 13
 
 # reference NetworkMessagePriority: replies < consensus < pool sync
 PRIORITY = {
@@ -173,6 +175,8 @@ PRIORITY = {
     KIND_PING_REQUEST: 2,
     KIND_SYNC_BLOCKS_REQUEST: 2,
     KIND_SYNC_POOL_REQUEST: 2,
+    KIND_PEERS_REQUEST: 2,
+    KIND_PEERS_REPLY: 2,
 }
 
 
@@ -370,3 +374,47 @@ def trie_nodes_reply(nodes: List[bytes]) -> NetworkMessage:
 
 def parse_trie_nodes_reply(msg: NetworkMessage) -> List[bytes]:
     return Reader(msg.body).bytes_list()
+
+
+# -- peer discovery (gossip-learned addresses; reference: the hub relay
+# network's bootstrap + peer exchange, HubConnector.cs:26-105 +
+# config_mainnet.json:22-33 — here peers exchange dialable addresses
+# directly) ------------------------------------------------------------------
+
+
+def peers_request(my_host: str, my_port: int) -> NetworkMessage:
+    """Ask a peer for its address book; carries OUR listening address so an
+    inbound-only acquaintance becomes dialable."""
+    return NetworkMessage(
+        KIND_PEERS_REQUEST,
+        write_bytes(my_host.encode()) + write_u32(my_port),
+    )
+
+
+def parse_peers_request(msg: NetworkMessage) -> Tuple[str, int]:
+    r = Reader(msg.body)
+    host = r.bytes_().decode()
+    port = r.u32()
+    r.assert_eof()
+    return host, port
+
+
+def peers_reply(peers: List[Tuple[bytes, str, int]]) -> NetworkMessage:
+    body = write_u32(len(peers))
+    for pub, host, port in peers:
+        body += write_bytes(pub) + write_bytes(host.encode()) + write_u32(port)
+    return NetworkMessage(KIND_PEERS_REPLY, body)
+
+
+def parse_peers_reply(msg: NetworkMessage) -> List[Tuple[bytes, str, int]]:
+    r = Reader(msg.body)
+    out = []
+    for _ in range(r.u32()):
+        pub = r.bytes_()
+        host = r.bytes_().decode()
+        port = r.u32()
+        if len(pub) != 33:
+            raise ValueError("bad peer pubkey length")
+        out.append((pub, host, port))
+    r.assert_eof()
+    return out

@@ -97,7 +97,17 @@ class MemoryKV(KVStore):
 
 
 class SqliteKV(KVStore):
-    """Durable KV on sqlite (WAL journaling ~ RocksDB WAL-sync semantics)."""
+    """Durable KV on sqlite WAL.
+
+    Durability contract (matching the reference's WAL-synced RocksDB writes,
+    RocksDbContext.cs:23-31): `write_batch` — the path every block commit,
+    DKG step and snapshot-index update rides — commits with
+    `synchronous=FULL`, i.e. the WAL is fsynced before the call returns, so
+    a power failure can never lose a committed block. Singleton put/delete
+    (per-tx pool persistence, best-effort by design) stay at
+    `synchronous=NORMAL`: under WAL that can lose the LAST few pool writes
+    on power loss but never corrupts, and the pool re-syncs from gossip.
+    """
 
     def __init__(self, path: str):
         self._conn = sqlite3.connect(path, check_same_thread=False)
@@ -130,15 +140,29 @@ class SqliteKV(KVStore):
 
     def write_batch(self, puts, deletes=()) -> None:
         with self._lock:
-            cur = self._conn.cursor()
-            cur.executemany(
-                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", list(puts)
-            )
-            if deletes:
+            # FULL for the batch commit: block persistence is exactly the
+            # write that must survive power failure; the fsync cost is paid
+            # once per block, not per key
+            self._conn.execute("PRAGMA synchronous=FULL")
+            try:
+                cur = self._conn.cursor()
                 cur.executemany(
-                    "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
+                    "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                    list(puts),
                 )
-            self._conn.commit()
+                if deletes:
+                    cur.executemany(
+                        "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
+                    )
+                self._conn.commit()
+            except BaseException:
+                # a half-written batch must NOT linger in the open implicit
+                # transaction, or the next unrelated put() would commit it
+                # and break the all-or-nothing contract
+                self._conn.rollback()
+                raise
+            finally:
+                self._conn.execute("PRAGMA synchronous=NORMAL")
 
     def scan_prefix(self, prefix: bytes):
         hi = prefix + b"\xff" * 8

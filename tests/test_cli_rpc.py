@@ -257,3 +257,96 @@ def test_operator_verbs(tmp_path):
     from lachain_tpu.storage.state import StateManager
 
     assert StateManager(kv).committed_height() == 2
+
+
+@pytest.mark.slow
+def test_seed_only_discovery_and_restart_rejoin(tmp_path):
+    """Deployment-slice acceptance (docker-compose.4nodes.yml flow):
+    a node seeded with ONE bootstrap address discovers the rest via gossip
+    and participates; a kill -9'd node restarted from its durable db
+    rejoins via sync and catches back up."""
+    port_base = 7420
+    netdir = tmp_path / "net"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", LOG_LEVEL="WARNING")
+    subprocess.run(
+        [
+            sys.executable, "-m", "lachain_tpu.cli", "keygen",
+            "--n", "4", "--f", "1", "--out", str(netdir),
+            "--port-base", str(port_base),
+            "--block-time-ms", "200",
+        ],
+        check=True, env=env, timeout=120,
+    )
+    # node 3 keeps ONLY node 0 as its config-seeded peer
+    cfg3_path = netdir / "config3.json"
+    cfg3 = json.loads(cfg3_path.read_text())
+    seed = [p for p in cfg3["network"]["peers"] if p.split(":", 2)[1] == str(port_base)]
+    assert len(seed) == 1
+    cfg3["network"]["peers"] = seed
+    cfg3_path.write_text(json.dumps(cfg3))
+
+    def start(i):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "lachain_tpu.cli", "run",
+                "--config", str(netdir / f"config{i}.json"),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    def wait_height(port, target, timeout=150):
+        deadline = time.time() + timeout
+        h = -1
+        while time.time() < deadline:
+            try:
+                h = int(rpc(port, "eth_blockNumber"), 16)
+                if h >= target:
+                    return h
+            except Exception:
+                pass
+            time.sleep(1.0)
+        return h
+
+    procs = {i: start(i) for i in range(4)}
+    try:
+        # gossip: node 3 must learn peers beyond its single seed and follow
+        rpc3 = port_base + 2 * 3 + 1
+        assert wait_height(rpc3, 2) >= 2, "seed-only node never followed"
+        deadline = time.time() + 60
+        peers3 = []
+        while time.time() < deadline:
+            try:
+                peers3 = rpc(rpc3, "net_peers")
+                if len(peers3) >= 3:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert len(peers3) >= 3, f"gossip discovery failed: {peers3}"
+
+        # kill -9 one validator; the remaining 3 >= n-f keep producing
+        procs[2].kill()
+        procs[2].wait()
+        h_after_kill = wait_height(port_base + 1, 3)
+        target = h_after_kill + 2
+        assert wait_height(port_base + 1, target) >= target, (
+            "chain stalled after losing one of four validators"
+        )
+
+        # restart from the durable db: node 2 rejoins via sync
+        procs[2] = start(2)
+        rpc2 = port_base + 2 * 2 + 1
+        tip = int(rpc(port_base + 1, "eth_blockNumber"), 16)
+        assert wait_height(rpc2, tip, timeout=180) >= tip, (
+            "restarted node never caught back up"
+        )
+    finally:
+        for p in procs.values():
+            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
