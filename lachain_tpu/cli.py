@@ -18,6 +18,11 @@ composition):
       reference `db` verb + --RollBackTo, Application.cs:119-127).
   lachain-tpu encrypt|decrypt --wallet ...
       wallet re-keying / decrypted inspection (reference encrypt/decrypt).
+  lachain-tpu console --rpc http://127.0.0.1:7071
+      interactive operator shell attached to a LIVE node over its RPC
+      (role of the reference's in-process console, CLI/ConsoleManager.cs:14
+      + ConsoleCommands.cs:20; attaching over RPC means the shell works
+      against any reachable node, containers included).
 """
 from __future__ import annotations
 
@@ -229,6 +234,151 @@ async def _run_node(cfg, args) -> None:
         raise failure
 
 
+CONSOLE_COMMANDS = """\
+Commands:
+  height                       chain tip
+  block <number|latest>        block summary
+  tx <hash>                    transaction
+  receipt <hash>               execution receipt
+  balance <0xaddr>             account balance
+  nonce <0xaddr>               account nonce
+  account                      the node wallet's account
+  peers                        connected peer pubkeys
+  validators                   current validator set
+  consensus                    era/N/F/keys summary
+  pool                         pending tx hashes
+  phase                        cycle phase (vrf/attendance windows)
+  penalty <0xaddr>             accrued attendance penalty
+  metrics                      node timer/counter snapshot
+  unlock <password> [seconds]  unlock the node wallet
+  lock?                        wallet lock status
+  send <0xto> <value>          transfer from the node wallet
+  sendraw <0xhex>              submit a raw signed tx
+  stake <amount>               stake from the node balance
+  unstake                      request stake withdrawal
+  help                         this text
+  exit                         leave the console
+"""
+
+
+def _console_eval(call, line: str) -> object:
+    """One console command -> RPC call(s). `call(method, *params)`."""
+    parts = line.split()
+    if not parts:
+        return None
+    cmd, args = parts[0].lower(), parts[1:]
+    if cmd in ("help", "?"):
+        return CONSOLE_COMMANDS
+    if cmd == "height":
+        return int(call("eth_blockNumber"), 16)
+    if cmd == "block":
+        tag = args[0] if args else "latest"
+        if tag.isdigit():
+            tag = hex(int(tag))
+        return call("eth_getBlockByNumber", tag, False)
+    if cmd == "tx":
+        return call("eth_getTransactionByHash", args[0])
+    if cmd == "receipt":
+        return call("eth_getTransactionReceipt", args[0])
+    if cmd == "balance":
+        return int(call("eth_getBalance", args[0]), 16)
+    if cmd == "nonce":
+        return int(call("eth_getTransactionCount", args[0]), 16)
+    if cmd == "account":
+        return call("fe_account")
+    if cmd == "peers":
+        return call("net_peers")
+    if cmd == "validators":
+        return call("la_getLatestValidators")
+    if cmd == "consensus":
+        return call("la_consensusState")
+    if cmd == "pool":
+        return call("eth_getTransactionPool")
+    if cmd == "phase":
+        return call("fe_phase")
+    if cmd == "penalty":
+        addr = args[0] if args else None
+        out = {"penalty": int(call("la_getPenalty", *( [addr] if addr else [] )), 16)}
+        if addr:
+            out.update(call("la_validatorInfo", addr))
+        return out
+    if cmd == "metrics":
+        return call("la_metrics")
+    if cmd == "unlock":
+        secs = hex(int(args[1])) if len(args) > 1 else "0x12c"
+        return call("fe_unlock", args[0], secs)
+    if cmd == "lock?":
+        return {"locked": call("fe_isLocked")}
+    if cmd == "send":
+        return call(
+            "eth_sendTransaction", {"to": args[0], "value": hex(int(args[1]))}
+        )
+    if cmd == "sendraw":
+        return call("eth_sendRawTransaction", args[0])
+    if cmd == "stake":
+        return call("validator_start_with_stake", hex(int(args[0])))
+    if cmd == "unstake":
+        return call("validator_stop")
+    raise ValueError(f"unknown command {cmd!r} (try 'help')")
+
+
+def cmd_console(args) -> int:
+    import urllib.request
+
+    def call(method, *params):
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+        ).encode()
+        req = urllib.request.Request(
+            args.rpc, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RuntimeError(out["error"].get("message", out["error"]))
+        return out["result"]
+
+    failures = [0]
+
+    def run_line(line) -> bool:
+        line = line.strip()
+        if line in ("exit", "quit"):
+            return False
+        if not line:
+            return True
+        try:
+            out = _console_eval(call, line)
+            if isinstance(out, str):
+                print(out)
+            else:
+                print(json.dumps(out, indent=2, sort_keys=True))
+        except Exception as exc:  # operator tool: report, keep the shell
+            failures[0] += 1
+            print(f"error: {exc}", file=sys.stderr)
+        return True
+
+    if args.exec:
+        for line in args.exec.split(";"):
+            if not run_line(line):
+                break
+        # scriptable mode: a failed command must fail the invocation so
+        # shell `&&` chains can react, unlike the keep-going interactive loop
+        return 1 if failures[0] else 0
+    try:
+        import readline  # noqa: F401  (history/arrow keys when available)
+    except ImportError:
+        pass
+    print(f"lachain-tpu console — attached to {args.rpc} ('help' for commands)")
+    while True:
+        try:
+            line = input("lachain> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not run_line(line):
+            return 0
+
+
 def cmd_run(args) -> int:
     from .core.config import NodeConfig
 
@@ -382,6 +532,17 @@ def main(argv=None) -> int:
     en.add_argument("--password", required=True)
     en.add_argument("--old-password", default=None)
     en.set_defaults(fn=cmd_encrypt)
+
+    co = sub.add_parser(
+        "console", help="interactive operator shell over a live node's RPC"
+    )
+    co.add_argument("--rpc", default="http://127.0.0.1:7071")
+    co.add_argument("--timeout", type=float, default=10.0)
+    co.add_argument(
+        "--exec",
+        help="run ';'-separated commands non-interactively and exit",
+    )
+    co.set_defaults(fn=cmd_console)
 
     de = sub.add_parser("decrypt", help="print a wallet's decrypted JSON")
     de.add_argument("--wallet", required=True)

@@ -15,6 +15,14 @@ Flags defined so far (heights default to 0 = active from genesis):
                            _skipDecryptedShareValidation, HoneyBadger.cs:30)
   boundary_finish_cycle    governance FinishCycle restricted to the cycle's
                            last block (round-2 rotation alignment rule)
+  fast_wasm_gas            the round-3 gas-schedule change: translatable
+                           WASM bills 200 gas/op (the translated tier's
+                           real dispatch speed) instead of the round-2
+                           interpreter-rate 2000/op. Below the activation
+                           height every instruction bills the old rate —
+                           the first REAL height-gated schedule change
+                           (the reference gates such repricings the same
+                           way, HardforkHeights.cs:1-164)
 """
 from __future__ import annotations
 
@@ -23,6 +31,7 @@ from typing import Dict
 _DEFAULTS: Dict[str, int] = {
     "strict_share_validation": 0,
     "boundary_finish_cycle": 0,
+    "fast_wasm_gas": 0,
 }
 
 _heights: Dict[str, int] = dict(_DEFAULTS)

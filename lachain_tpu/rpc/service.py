@@ -768,6 +768,15 @@ class RpcService:
         # (pool warm_sender_caches); parallel == batch here
         return self.la_sendRawTransactionBatch(raws)
 
+    def la_getPenalty(self, address=None):
+        """Accrued attendance penalty for an address (staking contract
+        penalty: key; burns out of withdrawals)."""
+        from ..core import system_contracts as sc
+
+        addr = _bytes(address) if address else self.node.address20
+        raw = self._snap().get("storage", sc.STAKING_ADDRESS + b"penalty:" + addr)
+        return _hex(int.from_bytes(raw, "big") if raw else 0)
+
     def la_getLatestValidators(self):
         return [
             _h(pk) for pk in self.node.public_keys.ecdsa_pub_keys

@@ -204,6 +204,11 @@ class Instance:
     ):
         self.module = module
         self.gas = gas or GasMeter(1 << 62)
+        # per-instruction gas multiplier for TRANSLATABLE code: 1 once the
+        # fast_wasm_gas hardfork is active, 10 below its height (the
+        # round-2 schedule). Set by the VM from the block height; bulk/
+        # memory/input gas is unaffected (those prices never changed).
+        self.tgas_scale = 1
         self.host = host or {}
         self._imported_funcs: List[Tuple[FuncType, HostFunc]] = []
         for im in module.imports:
@@ -387,6 +392,8 @@ class Instance:
         charge = self.gas.charge
         n_body = len(body)
         rate = getattr(fn, "_gas_rate", INTERP_INSTRUCTION_GAS)
+        if rate == INSTRUCTION_GAS and self.tgas_scale != 1:
+            rate *= self.tgas_scale  # pre-fast_wasm_gas schedule
 
         while pc < n_body:
             ins = body[pc]

@@ -298,7 +298,7 @@ class _Compiler:
             self.pending_gas = 0
         # inline settle: on an OutOfGas raise _g stays set and the finally
         # re-charges it — harmless, the meter clamps spent to the limit
-        self.emit("inst.gas.charge(_g)")
+        self.emit("inst.gas.charge(_g * inst.tgas_scale)")
         self.emit("_g = 0")
 
     def soft_gas(self) -> None:
@@ -741,7 +741,7 @@ class _Compiler:
         self.indent = 1
         self.emit("finally:")
         self.indent += 1
-        self.emit("inst.gas.charge(_g)")
+        self.emit("inst.gas.charge(_g * inst.tgas_scale)")
         return "\n".join(self.lines) + "\n"
 
 

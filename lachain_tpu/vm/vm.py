@@ -16,7 +16,14 @@ from ..crypto.hashes import keccak256
 from ..storage.state import Snapshot
 from . import gas as G
 from .external import build_env
-from .interpreter import GasMeter, Instance, OutOfGas, WasmTrap
+from .interpreter import (
+    INSTRUCTION_GAS,
+    INTERP_INSTRUCTION_GAS,
+    GasMeter,
+    Instance,
+    OutOfGas,
+    WasmTrap,
+)
 from .wasm import WasmDecodeError, decode_module
 
 MAX_FRAME_DEPTH = 16
@@ -200,6 +207,16 @@ class VirtualMachine:
                 frame.instance = Instance(
                     module, host=build_env(self, frame), gas=meter
                 )
+                from ..core import hardforks
+
+                if not hardforks.is_active(
+                    "fast_wasm_gas", self.block_index
+                ):
+                    # pre-fork schedule: translatable code bills the
+                    # round-2 interpreter rate (2000/op) too
+                    frame.instance.tgas_scale = (
+                        INTERP_INSTRUCTION_GAS // INSTRUCTION_GAS
+                    )
                 frame.instance.invoke("start", [])
         except HaltException as e:
             status = 1 if e.code == 0 else 0

@@ -350,3 +350,68 @@ def test_seed_only_discovery_and_restart_rejoin(tmp_path):
                 p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_operator_console_against_live_node(tmp_path):
+    """The interactive operator shell (reference ConsoleManager.cs:14 +
+    ConsoleCommands.cs:20) attaches to a live node over RPC; --exec drives
+    it scriptably."""
+    import asyncio
+
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.core.node import Node
+
+    class Rng:
+        def __init__(self, seed):
+            import random
+
+            self._r = random.Random(seed)
+
+        def randbelow(self, n):
+            return self._r.randrange(n)
+
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(8))
+
+    async def main():
+        node = Node(
+            index=0,
+            public_keys=pub,
+            private_keys=privs[0],
+            chain_id=CHAIN,
+            initial_balances={},
+        )
+        srv = await node.start_rpc("127.0.0.1", 0)
+
+        def drive(cmds):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            return subprocess.run(
+                [
+                    sys.executable, "-m", "lachain_tpu.cli", "console",
+                    "--rpc", f"http://127.0.0.1:{srv.port}/",
+                    "--exec", cmds,
+                ],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+
+        out = await asyncio.to_thread(
+            drive, "height; validators; consensus; account; phase; help"
+        )
+        assert out.returncode == 0, out.stderr
+        assert "\n0\n" in "\n" + out.stdout  # height 0
+        payload = out.stdout
+        assert payload.count("0x") > 4  # validators + account rendered
+        assert '"n": 4' in payload and '"f": 1' in payload
+        assert "Commands:" in payload
+        # unknown commands report, keep executing the rest, and fail the
+        # scriptable invocation's exit code
+        out2 = await asyncio.to_thread(drive, "bogus; height")
+        assert "unknown command" in out2.stderr
+        assert "0" in out2.stdout
+        assert out2.returncode == 1
+        out3 = await asyncio.to_thread(drive, "penalty")
+        assert out3.returncode == 0 and '"penalty": 0' in out3.stdout
+
+    asyncio.run(main())
