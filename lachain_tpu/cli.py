@@ -100,6 +100,9 @@ def cmd_keygen(args) -> int:
             "staking": {
                 "cycleDuration": args.cycle_duration,
                 "vrfSubmissionPhase": args.vrf_phase,
+                "attendanceDetectionDuration": max(
+                    min(100, args.cycle_duration // 5), 1
+                ),
             },
             "rpc": {
                 "enabled": True,
@@ -132,7 +135,9 @@ def _build_node(cfg, config_path=None):
     from .storage.kv import SqliteKV
 
     sc.set_cycle_params(
-        cfg.staking.cycle_duration, cfg.staking.vrf_submission_phase
+        cfg.staking.cycle_duration,
+        cfg.staking.vrf_submission_phase,
+        cfg.staking.attendance_detection_duration,
     )
     if cfg.hardfork.heights:
         set_hardfork_heights(cfg.hardfork.heights, force=True)
@@ -375,8 +380,12 @@ def cmd_console(args) -> int:
         except (EOFError, KeyboardInterrupt):
             print()
             return 0
-        if not run_line(line):
-            return 0
+        try:
+            if not run_line(line):
+                return 0
+        except KeyboardInterrupt:
+            # ^C mid-command aborts the command, not the shell
+            print("\ninterrupted", file=sys.stderr)
 
 
 def cmd_run(args) -> int:

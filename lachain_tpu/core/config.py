@@ -14,7 +14,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-CURRENT_VERSION = 3
+CURRENT_VERSION = 6
 
 # -- migrations --------------------------------------------------------------
 # each migrates version N -> N+1 (reference runs 17 of these sequentially)
@@ -47,6 +47,39 @@ def _v2_to_v3(cfg: dict) -> dict:
     staking.setdefault("cycleDuration", 1000)
     staking.setdefault("vrfSubmissionPhase", 500)
     cfg.setdefault("hardfork", {})
+    return cfg
+
+
+@_migration(3)
+def _v3_to_v4(cfg: dict) -> dict:
+    # v4 (round 4, gossip peer discovery): an explicit dialable address for
+    # wildcard binds / NAT — None keeps the bind host
+    cfg.setdefault("network", {}).setdefault("advertiseHost", None)
+    return cfg
+
+
+@_migration(4)
+def _v4_to_v5(cfg: dict) -> dict:
+    # v5 (round 4, on-chain attendance detection): the detection-window
+    # length joined the consensus-critical cycle parameters. The default
+    # scales with the config's OWN cycle (same formula keygen uses) so a
+    # short-cycle chain never gets a window that outlives the cycle
+    staking = cfg.setdefault("staking", {})
+    cycle = int(staking.get("cycleDuration", 1000))
+    staking.setdefault(
+        "attendanceDetectionDuration", max(min(100, cycle // 5), 1)
+    )
+    return cfg
+
+
+@_migration(5)
+def _v5_to_v6(cfg: dict) -> dict:
+    # v6 (round 4, fast_wasm_gas hardfork): configs carry the repricing
+    # height explicitly — chains generated before the fork default to 0
+    # (active from genesis); a LIVE pre-v6 chain must set its upgrade
+    # height here before any node restarts on the new software
+    hf = cfg.setdefault("hardfork", {})
+    hf.setdefault("heights", {}).setdefault("fast_wasm_gas", 0)
     return cfg
 
 
@@ -102,6 +135,7 @@ class VaultSection:
 class StakingSection:
     cycle_duration: int = 1000
     vrf_submission_phase: int = 500
+    attendance_detection_duration: int = 100
 
 
 @dataclass
@@ -172,6 +206,9 @@ class NodeConfig:
                 cycle_duration=int(staking.get("cycleDuration", 1000)),
                 vrf_submission_phase=int(
                     staking.get("vrfSubmissionPhase", 500)
+                ),
+                attendance_detection_duration=int(
+                    staking.get("attendanceDetectionDuration", 100)
                 ),
             ),
             rpc=RpcSection(

@@ -52,6 +52,10 @@ def set_cycle_params(
     identically on every node before the chain starts."""
     global CYCLE_DURATION, VRF_SUBMISSION_PHASE, ATTENDANCE_DETECTION_DURATION
     assert 0 < vrf_submission_phase < cycle_duration
+    # the detection window must CLOSE within the cycle or finish/settlement
+    # can never run; clamp deterministically (same config -> same params on
+    # every node) rather than brick short-cycle configs
+    attendance_detection = max(1, min(attendance_detection, cycle_duration - 1))
     CYCLE_DURATION = cycle_duration
     VRF_SUBMISSION_PHASE = vrf_submission_phase
     ATTENDANCE_DETECTION_DURATION = attendance_detection

@@ -48,3 +48,29 @@ def test_hardfork_flags():
             hardforks.set_hardfork_heights({"bogus": 1}, force=True)
     finally:
         hardforks.reset_for_tests()
+
+
+def test_round4_migrations_v3_to_v6():
+    """The round-4 feature set carried three REAL migrations: advertiseHost
+    (gossip discovery), attendanceDetectionDuration (on-chain attendance),
+    and the fast_wasm_gas repricing height (first gas-schedule hardfork)."""
+    from lachain_tpu.core.config import CURRENT_VERSION, migrate
+
+    v3 = {
+        "version": 3,
+        "network": {"host": "1.2.3.4", "port": 9},
+        "staking": {"cycleDuration": 50, "vrfSubmissionPhase": 20},
+        "hardfork": {},
+    }
+    out = migrate(v3)
+    assert out["version"] == CURRENT_VERSION == 6
+    assert out["network"]["advertiseHost"] is None
+    # scaled to the config's own short cycle (50 // 5), never >= the cycle
+    assert out["staking"]["attendanceDetectionDuration"] == 10
+    assert out["hardfork"]["heights"]["fast_wasm_gas"] == 0
+    # values an operator already set are never clobbered
+    v5 = {
+        "version": 5,
+        "hardfork": {"heights": {"fast_wasm_gas": 12345}},
+    }
+    assert migrate(v5)["hardfork"]["heights"]["fast_wasm_gas"] == 12345
