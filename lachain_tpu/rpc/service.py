@@ -507,9 +507,17 @@ class RpcService:
         return {
             "address": _h(addr),
             "stake": _hex(stake),
+            "penalty": self._penalty_hex(addr, snap),
             "isValidator": in_set,
             "publicKey": _h(pub) if pub else None,
         }
+
+    def _penalty_hex(self, addr: bytes, snap=None) -> str:
+        from ..core import system_contracts as sc
+
+        snap = snap if snap is not None else self._snap()
+        raw = snap.get("storage", sc.STAKING_ADDRESS + b"penalty:" + addr)
+        return _hex(int.from_bytes(raw, "big") if raw else 0)
 
     def la_attendance(self, cycle=None):
         """Per-cycle signed-header attendance counts (the durable tracking
@@ -771,11 +779,8 @@ class RpcService:
     def la_getPenalty(self, address=None):
         """Accrued attendance penalty for an address (staking contract
         penalty: key; burns out of withdrawals)."""
-        from ..core import system_contracts as sc
-
         addr = _bytes(address) if address else self.node.address20
-        raw = self._snap().get("storage", sc.STAKING_ADDRESS + b"penalty:" + addr)
-        return _hex(int.from_bytes(raw, "big") if raw else 0)
+        return self._penalty_hex(addr)
 
     def la_getLatestValidators(self):
         return [

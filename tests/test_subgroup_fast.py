@@ -7,23 +7,28 @@ The native backend's membership check is now the GLV-endomorphism test
 This is consensus-safety-critical: round 4 already demonstrated that a
 guessed membership shortcut (the aggregate RLC check) admits torsion
 forgeries that split honest validators. So the fast test ships with a
-MACHINE-CHECKED certificate, not a literature citation:
+MACHINE-CHECKED certificate, not a literature citation. The certificate
+is DETERMINISTIC (test_deterministic_kernel_certificate):
 
-  psi := phi - [lambda] is a group endomorphism, so for P = S + sum(T_q)
-  (S in G1, T_q in the q-part of the cofactor torsion), psi(P) = sum
-  psi(T_q) with each term inside its own q-part. The test is sound iff
-  ker(psi) meets every prime-power torsion component trivially. E(Fp)'s
-  order is h1 * r with h1 = 3 * 11^2 * 10177^2 * 859267^2 * 52437899^2
-  (derived and re-verified below from h1 = (z-1)^2 / 3); for every
-  prime-power q^j || h1 we sample many points of EXACT order q^j from
-  random full-curve points and require psi != 0 on all of them. If
-  ker(psi) contained a nontrivial subgroup of the q-part, a random
-  exact-order point would land in it with probability >= 1/(q+1) per
-  sample — 48 independent samples bound the miss probability below
-  2^-66 even for q = 3.
+  1. phi^3 = id is a coordinate identity (beta^3 == 1 in Fp — checked),
+     and phi != id.
+  2. E is ordinary: its trace t = z+1 satisfies t != 0 and t % p != 0
+     (checked), so End(E) embeds in an imaginary quadratic order — an
+     integral domain. With phi^3 - 1 = (phi - 1)(phi^2 + phi + 1) = 0
+     and phi != 1, that forces phi^2 + phi + 1 = 0 in End(E).
+  3. Suppose psi(T) = 0 for torsion T of order q^j | h1, psi := phi -
+     [lambda]. Then phi(T) = [lambda]T, so 0 = (phi^2 + phi + 1)(T) =
+     [lambda^2 + lambda + 1]T, hence q^j divides lambda^2 + lambda + 1.
+     But (z^2-1)^2 + (z^2-1) + 1 == z^4 - z^2 + 1 == r as INTEGERS
+     (checked), and gcd(r, h1) == 1 (checked, r prime) — so no such T
+     exists: ker(psi) meets the cofactor torsion trivially, i.e. the
+     fast test accepts EXACTLY G1.
 
-The same fixtures differentially pin the NATIVE C++ routine against the
-oracle's full-order check.
+The sampling test below is a belt-and-suspenders EMPIRICAL cross-check
+of the implementation (every prime-power torsion component exercised,
+element orders derived — the 11-part is Z_11 x Z_11, non-cyclic), NOT
+the soundness source; the same fixtures differentially pin the NATIVE
+C++ routine against the oracle's full-order check.
 """
 import random
 
@@ -97,6 +102,24 @@ def _h1_prime_powers():
     return pw
 
 
+def test_deterministic_kernel_certificate():
+    """The four numeric facts that make the fast test sound (see module
+    docstring for the argument they assemble into)."""
+    # (1) phi^3 = id coordinatewise, phi != id
+    assert pow(BETA, 3, P) == 1 and BETA != 1
+    # (2) E is ordinary (nonzero trace, not divisible by p)
+    t = Z + 1
+    assert t != 0 and t % P != 0
+    # (3) lambda^2 + lambda + 1 equals r EXACTLY as integers
+    lam = Z * Z - 1
+    assert lam * lam + lam + 1 == R
+    assert LAMBDA == lam % R == lam  # and lambda < r, so no reduction slack
+    # (4) r shares no factor with the cofactor
+    import math
+
+    assert math.gcd(R, H1) == 1
+
+
 def test_group_order_identity():
     # #E(Fp) = p + 1 - t with trace t = z + 1; equals h1 * r
     assert H1 * R == P + 1 - (Z + 1)
@@ -110,10 +133,11 @@ def test_group_order_identity():
 
 
 def test_certificate_every_prime_power_torsion_rejected():
-    """For every prime q | h1: project random full-curve points onto the
-    q-part ([n/q^e]P), walk each point's q-chain (T, [q]T, ...) to cover
-    every EXACT element order the component contains, and require psi != 0
-    on >= SAMPLES independent points per exact order. Element orders are
+    """Empirical cross-check of the deterministic certificate: for every
+    prime q | h1, project random full-curve points onto the q-part
+    ([n/q^e]P), walk each point's q-chain (T, [q]T, ...) to cover every
+    EXACT element order the component contains, and require psi != 0 on
+    SAMPLES independent points per exact order. Element orders are
     derived empirically because the q-parts need not be cyclic — the
     11-part, e.g., is Z_11 x Z_11, so no order-121 element exists."""
     rng = random.Random(0xBEEF)
