@@ -984,10 +984,74 @@ static bool g1_in_subgroup(const G1 &p) {
   fp_mul(ph.x, p.x, beta);
   return g1_eq_proj(ph, lam);
 }
+static bool g2_eq_proj(const G2 &p, const G2 &q) {
+  bool pi = g2_is_inf(p), qi = g2_is_inf(q);
+  if (pi || qi) return pi == qi;
+  Fp2 z1z1, z2z2, a, b;
+  fp2_sqr(z1z1, p.z);
+  fp2_sqr(z2z2, q.z);
+  fp2_mul(a, p.x, z2z2);
+  fp2_mul(b, q.x, z1z1);
+  if (!fp2_eq(a, b)) return false;
+  Fp2 z1c, z2c;
+  fp2_mul(z1c, z1z1, p.z);
+  fp2_mul(z2c, z2z2, q.z);
+  fp2_mul(a, p.y, z2c);
+  fp2_mul(b, q.y, z1c);
+  return fp2_eq(a, b);
+}
+
+// untwist-Frobenius-twist constants: A = 1/xi^((p-1)/3),
+// B = 1/xi^((p-1)/2) with xi = 1 + i (derived numerically and pinned
+// structurally by tests/test_subgroup_fast_g2.py)
+static const uint8_t PSI_AX_C1[48] = {
+    0x1a, 0x01, 0x11, 0xea, 0x39, 0x7f, 0xe6, 0x99, 0xec, 0x02, 0x40, 0x86,
+    0x63, 0xd4, 0xde, 0x85, 0xaa, 0x0d, 0x85, 0x7d, 0x89, 0x75, 0x9a, 0xd4,
+    0x89, 0x7d, 0x29, 0x65, 0x0f, 0xb8, 0x5f, 0x9b, 0x40, 0x94, 0x27, 0xeb,
+    0x4f, 0x49, 0xff, 0xfd, 0x8b, 0xfd, 0x00, 0x00, 0x00, 0x00, 0xaa, 0xad,
+};
+static const uint8_t PSI_BY_C0[48] = {
+    0x13, 0x52, 0x03, 0xe6, 0x01, 0x80, 0xa6, 0x8e, 0xe2, 0xe9, 0xc4, 0x48,
+    0xd7, 0x7a, 0x2c, 0xd9, 0x1c, 0x3d, 0xed, 0xd9, 0x30, 0xb1, 0xcf, 0x60,
+    0xef, 0x39, 0x64, 0x89, 0xf6, 0x1e, 0xb4, 0x5e, 0x30, 0x44, 0x66, 0xcf,
+    0x3e, 0x67, 0xfa, 0x0a, 0xf1, 0xee, 0x7b, 0x04, 0x12, 0x1b, 0xde, 0xa2,
+};
+static const uint8_t PSI_BY_C1[48] = {
+    0x06, 0xaf, 0x0e, 0x04, 0x37, 0xff, 0x40, 0x0b, 0x68, 0x31, 0xe3, 0x6d,
+    0x6b, 0xd1, 0x7f, 0xfe, 0x48, 0x39, 0x5d, 0xab, 0xc2, 0xd3, 0x43, 0x5e,
+    0x77, 0xf7, 0x6e, 0x17, 0x00, 0x92, 0x41, 0xc5, 0xee, 0x67, 0x99, 0x2f,
+    0x72, 0xec, 0x05, 0xf4, 0xc8, 0x10, 0x84, 0xfb, 0xed, 0xe3, 0xcc, 0x09,
+};
+
 static bool g2_in_subgroup(const G2 &p) {
-  G2 t;
-  g2_mul_scalar(t, p, R_BYTES_BE, 32);
-  return g2_is_inf(t);
+  // Certified fast membership test: Q in G2 iff psi(Q) == [z]Q, psi the
+  // untwist-Frobenius-twist endomorphism psi(x, y) =
+  // (A * conj(x), B * conj(y)). Soundness (deterministic, machine-checked
+  // by tests/test_subgroup_fast_g2.py): psi satisfies
+  // psi^2 - [t]psi + [p] = 0, so a torsion kernel element of order m | h2
+  // would force m | z^2 - t*z + p == p - z — and gcd(p - z, h2) == 1.
+  // On Jacobian coords conj is a field automorphism: psi(X, Y, Z) =
+  // (A*conj(X), B*conj(Y), conj(Z)). Cost: one 64-bit ladder (~64 G2
+  // doublings) vs [r]Q's 255 — ~3.5x faster.
+  if (g2_is_inf(p)) return true;
+  Fp2 ax, by;
+  ax.c0 = FP_ZERO;
+  fp_from_bytes_be(ax.c1, PSI_AX_C1);
+  fp_from_bytes_be(by.c0, PSI_BY_C0);
+  fp_from_bytes_be(by.c1, PSI_BY_C1);
+  G2 ph, conj;
+  conj = p;
+  fp_neg(conj.x.c1, p.x.c1);
+  fp_neg(conj.y.c1, p.y.c1);
+  fp_neg(conj.z.c1, p.z.c1);
+  ph = conj;
+  fp2_mul(ph.x, conj.x, ax);
+  fp2_mul(ph.y, conj.y, by);
+  // [z]Q = -[|z|]Q (z is negative)
+  G2 t, lam;
+  g2_mul_scalar(t, p, Z_ABS_BE, 8);
+  g2_neg(lam, t);
+  return g2_eq_proj(ph, lam);
 }
 
 // ===========================================================================
