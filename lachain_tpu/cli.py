@@ -111,7 +111,11 @@ def cmd_keygen(args) -> int:
                 "apiKey": None,
             },
             "blockchain": {"targetTxsPerBlock": 1000, "targetBlockTimeMs": args.block_time_ms},
-            "hardfork": {"heights": {}},
+            # fresh chains activate every current hardfork from genesis —
+            # written EXPLICITLY so the chain's schedule never depends on
+            # library defaults (migrated configs get the NEVER sentinel
+            # instead, core/config.py _v5_to_v6)
+            "hardfork": {"heights": {"fast_wasm_gas": 0}},
         }
         path = os.path.join(args.out, f"config{i}.json")
         with open(path, "w") as fh:

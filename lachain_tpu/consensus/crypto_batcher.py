@@ -71,21 +71,35 @@ class TpkeEraBatcher:
             return 0
         backend = get_backend()
         era_fn = backend.tpke_era_verify_combine
-        # all submissions in one sim share the same validator set; chunk the
-        # flat job list only to bound the device-side S_pad shape
+        # submissions normally share one key-set object (one sim, one static
+        # validator set), but shares MUST verify against their own keys —
+        # group by key-set identity so a future caller with per-era DKG keys
+        # can never have shares checked against another era's keys
         flat_jobs: List = []
         owners: List[Tuple[int, int]] = []  # (submission idx, job idx)
-        for si, (jobs, _vks, _cb) in enumerate(batch):
+        key_of: List = []  # per-flat-job key-set object
+        for si, (jobs, vks, _cb) in enumerate(batch):
             for ji, job in enumerate(jobs):
                 flat_jobs.append(job)
                 owners.append((si, ji))
-        vks = batch[0][1]
+                key_of.append(vks)
         results: List = [None] * len(flat_jobs)
         try:
-            for off in range(0, len(flat_jobs), self.max_slots_per_call):
-                chunk = flat_jobs[off : off + self.max_slots_per_call]
-                out = era_fn(chunk, vks)
+            off = 0
+            while off < len(flat_jobs):
+                # chunk bounds the device S_pad shape AND stays within one
+                # key-set run (era_fn takes a single key set per call)
+                vks = key_of[off]
+                end = off + 1
+                while (
+                    end < len(flat_jobs)
+                    and end - off < self.max_slots_per_call
+                    and key_of[end] is vks
+                ):
+                    end += 1
+                out = era_fn(flat_jobs[off:end], vks)
                 results[off : off + len(out)] = out
+                off = end
         except Exception:
             # device path broken mid-flush: liveness beats acceleration —
             # every submitter falls back to its per-slot host path

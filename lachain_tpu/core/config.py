@@ -16,6 +16,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 CURRENT_VERSION = 6
 
+# "not scheduled yet" sentinel for migrated hardfork heights: far above any
+# realistic chain height, so is_active() stays False until the operator
+# coordinates a real activation height across the validator set
+HARDFORK_HEIGHT_NEVER = 2**62
+
 # -- migrations --------------------------------------------------------------
 # each migrates version N -> N+1 (reference runs 17 of these sequentially)
 
@@ -75,11 +80,17 @@ def _v4_to_v5(cfg: dict) -> dict:
 @_migration(5)
 def _v5_to_v6(cfg: dict) -> dict:
     # v6 (round 4, fast_wasm_gas hardfork): configs carry the repricing
-    # height explicitly — chains generated before the fork default to 0
-    # (active from genesis); a LIVE pre-v6 chain must set its upgrade
-    # height here before any node restarts on the new software
+    # height explicitly. A MIGRATED config belongs to a chain that ran
+    # under the old gas schedule, so defaulting to 0 would retroactively
+    # reprice historical blocks and break resync-from-genesis validation.
+    # Default to the far-future sentinel: the old schedule stays in force
+    # until the operator coordinates an explicit upgrade height. Configs
+    # generated fresh at v6 (cli.py keygen) write fast_wasm_gas: 0
+    # explicitly, so they never hit this default.
     hf = cfg.setdefault("hardfork", {})
-    hf.setdefault("heights", {}).setdefault("fast_wasm_gas", 0)
+    hf.setdefault("heights", {}).setdefault(
+        "fast_wasm_gas", HARDFORK_HEIGHT_NEVER
+    )
     return cfg
 
 

@@ -40,6 +40,9 @@ ATTENDANCE_DETECTION_DURATION = 100
 # detection check-in forfeits its share AND accrues that much penalty
 # against its stake, StakingContract.cs:656-720)
 ATTENDANCE_CYCLE_REWARD = 1000 * 10**18
+# how many cycles back a finish tx will lazily settle orphaned attendance
+# state (a cycle whose close tx never landed); bounds per-tx work
+ATTENDANCE_SETTLE_LOOKBACK = 8
 
 
 def set_cycle_params(
@@ -324,68 +327,45 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
         # it as a penalty against its stake. Idempotent per cycle; any
         # validator may send the close tx once the window has passed (the
         # reference injects it as a block-production system tx instead).
+        # A cycle whose close tx never landed before the cycle ended is
+        # settled LAZILY here: any later finish first sweeps unsettled
+        # prior cycles (their electorate snapshotted at rotation, see
+        # SEL_FINISH_CYCLE) so rewards/penalties are never silently lost.
         cycle = ctx.block // CYCLE_DURATION
         if cycle == 0 or ctx.block % CYCLE_DURATION < ATTENDANCE_DETECTION_DURATION:
             return 0, b""
-        done_key = b"att_done:" + write_u64(cycle)
-        if ctx.sget(STAKING_ADDRESS, done_key):
-            return 0, b""
-        prev_raw = ctx.sget(STAKING_ADDRESS, b"prev_pubs")
-        prev_pubs = Reader(prev_raw).bytes_list() if prev_raw else []
-        if not prev_pubs:
-            return 0, b""
-        ctx.sput(STAKING_ADDRESS, done_key, b"\x01")
-        raw = ctx.sget(STAKING_ADDRESS, b"att_checkin:" + write_u64(cycle))
-        voters = Reader(raw).bytes_list() if raw else []
-        max_share = ATTENDANCE_CYCLE_REWARD // len(prev_pubs)
-        from ..crypto.ecdsa import address_from_public_key
-
-        for pub in prev_pubs:
-            addr = address_from_public_key(pub)
-            pen_key = b"penalty:" + addr
-            penalty = int.from_bytes(
-                ctx.sget(STAKING_ADDRESS, pen_key) or b"", "big"
-            )
-            if pub not in voters:
-                penalty += max_share  # no-show: reward-sized penalty
-            vkey = b"att_votes:" + write_u64(cycle) + pub
-            votes_raw = ctx.sget(STAKING_ADDRESS, vkey) or b""
-            votes = sorted(
-                int.from_bytes(votes_raw[i : i + 4], "big")
-                for i in range(0, len(votes_raw), 4)
-            )
-            if votes:
-                mid = len(votes) // 2
-                active = (
-                    (votes[mid - 1] + votes[mid]) // 2
-                    if len(votes) % 2 == 0
-                    else votes[mid]
-                )
-            else:
-                active = 0
-            reward = max_share * active // CYCLE_DURATION
-            burn = min(penalty, reward)
-            penalty -= burn
-            reward -= burn
-            if penalty:
-                ctx.sput(STAKING_ADDRESS, pen_key, write_u256(penalty))
-            else:
-                ctx.sdel(STAKING_ADDRESS, pen_key)
-            if reward:
-                execution.set_balance(
-                    ctx.snap,
-                    addr,
-                    execution.get_balance(ctx.snap, addr) + reward,
-                )
-            ctx.sdel(STAKING_ADDRESS, vkey)
-        # settle-time cleanup (reference ClearAttendanceDetectorCheckIns):
-        # the voter list is never read again, and the previous cycle's done
-        # flag is out of every code path once this cycle settles
-        ctx.sdel(STAKING_ADDRESS, b"att_checkin:" + write_u64(cycle))
-        if cycle > 1:
-            ctx.sdel(STAKING_ADDRESS, b"att_done:" + write_u64(cycle - 1))
-        ctx.emit(STAKING_ADDRESS, b"attendance_finished" + write_u64(cycle))
-        return 1, b""
+        # `att_settled` is the high-water mark of settled cycles: any cycle
+        # above it is unsettled EVEN IF it left no state behind (a fully
+        # stalled cycle with zero check-ins must still hand out no-show
+        # penalties). Chains predating the watermark fall back to the
+        # evidence gate for the one-time transition, since their settled
+        # cycles cleaned up their done flags.
+        wm_raw = ctx.sget(STAKING_ADDRESS, b"att_settled")
+        watermark = int.from_bytes(wm_raw, "big") if wm_raw else None
+        settled = 0
+        high = watermark or 0
+        lo = max(1, cycle - ATTENDANCE_SETTLE_LOOKBACK + 1)
+        for x in range(lo, cycle):
+            if ctx.sget(STAKING_ADDRESS, b"att_done:" + write_u64(x)):
+                continue
+            if watermark is not None:
+                if x <= watermark:
+                    continue
+            elif not (
+                ctx.sget(STAKING_ADDRESS, b"att_checkin:" + write_u64(x))
+                or ctx.sget(STAKING_ADDRESS, b"att_pubs:" + write_u64(x))
+            ):
+                continue
+            if _settle_attendance_cycle(ctx, x):
+                settled += 1
+                high = max(high, x)
+        if not ctx.sget(STAKING_ADDRESS, b"att_done:" + write_u64(cycle)):
+            if _settle_attendance_cycle(ctx, cycle):
+                settled += 1
+                high = cycle
+        if settled and high > (watermark or 0):
+            ctx.sput(STAKING_ADDRESS, b"att_settled", write_u64(high))
+        return (1, b"") if settled else (0, b"")
 
     if sel == SEL_GET_PENALTY:
         addr = args.raw(ADDRESS_BYTES)
@@ -466,6 +446,77 @@ def staking(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[int, 
         return 0, b""
 
     return 0, b""
+
+
+def _settle_attendance_cycle(ctx: SystemContractContext, x: int) -> int:
+    """Distribute cycle `x`'s attendance rewards/penalties (reference
+    DistributeRewardsAndPenalties, StakingContract.cs:656-720) and clean its
+    per-cycle state. Electorate: the rotation-time snapshot `att_pubs:x` if
+    the validator set changed since, else the live prev_pubs. Returns 1 if
+    settled, 0 if there was no electorate to settle against."""
+    cyc = write_u64(x)
+    pubs_raw = ctx.sget(STAKING_ADDRESS, b"att_pubs:" + cyc) or ctx.sget(
+        STAKING_ADDRESS, b"prev_pubs"
+    )
+    prev_pubs = Reader(pubs_raw).bytes_list() if pubs_raw else []
+    if not prev_pubs:
+        return 0
+    ctx.sput(STAKING_ADDRESS, b"att_done:" + cyc, b"\x01")
+    raw = ctx.sget(STAKING_ADDRESS, b"att_checkin:" + cyc)
+    voters = Reader(raw).bytes_list() if raw else []
+    max_share = ATTENDANCE_CYCLE_REWARD // len(prev_pubs)
+    from ..crypto.ecdsa import address_from_public_key
+
+    for pub in prev_pubs:
+        addr = address_from_public_key(pub)
+        pen_key = b"penalty:" + addr
+        penalty = int.from_bytes(
+            ctx.sget(STAKING_ADDRESS, pen_key) or b"", "big"
+        )
+        if pub not in voters:
+            penalty += max_share  # no-show: reward-sized penalty
+        vkey = b"att_votes:" + cyc + pub
+        votes_raw = ctx.sget(STAKING_ADDRESS, vkey) or b""
+        votes = sorted(
+            int.from_bytes(votes_raw[i : i + 4], "big")
+            for i in range(0, len(votes_raw), 4)
+        )
+        if votes:
+            mid = len(votes) // 2
+            active = (
+                (votes[mid - 1] + votes[mid]) // 2
+                if len(votes) % 2 == 0
+                else votes[mid]
+            )
+        else:
+            active = 0
+        reward = max_share * active // CYCLE_DURATION
+        burn = min(penalty, reward)
+        penalty -= burn
+        reward -= burn
+        if penalty:
+            ctx.sput(STAKING_ADDRESS, pen_key, write_u256(penalty))
+        else:
+            ctx.sdel(STAKING_ADDRESS, pen_key)
+        if reward:
+            execution.set_balance(
+                ctx.snap,
+                addr,
+                execution.get_balance(ctx.snap, addr) + reward,
+            )
+        ctx.sdel(STAKING_ADDRESS, vkey)
+    # settle-time cleanup (reference ClearAttendanceDetectorCheckIns); the
+    # done flag itself is kept for ATTENDANCE_SETTLE_LOOKBACK cycles so the
+    # lazy sweep can tell "settled" from "orphaned", then swept
+    ctx.sdel(STAKING_ADDRESS, b"att_checkin:" + cyc)
+    ctx.sdel(STAKING_ADDRESS, b"att_pubs:" + cyc)
+    if x > ATTENDANCE_SETTLE_LOOKBACK:
+        ctx.sdel(
+            STAKING_ADDRESS,
+            b"att_done:" + write_u64(x - ATTENDANCE_SETTLE_LOOKBACK),
+        )
+    ctx.emit(STAKING_ADDRESS, b"attendance_finished" + cyc)
+    return 1
 
 
 def _get_winner_list(ctx, cycle: int) -> List[bytes]:
@@ -591,6 +642,38 @@ def governance(ctx: SystemContractContext, sel: bytes, args: Reader) -> Tuple[in
                 from ..utils.serialization import write_bytes_list
 
                 if outgoing is not None:
+                    # preserve the electorate of any cycle whose attendance
+                    # close tx hasn't landed yet: once prev_pubs rotates,
+                    # a lazy finishAttendanceDetection for those cycles
+                    # needs the set they actually voted with
+                    cyc_now = ctx.block // CYCLE_DURATION
+                    prev_raw = ctx.sget(STAKING_ADDRESS, b"prev_pubs")
+                    wm_raw = ctx.sget(STAKING_ADDRESS, b"att_settled")
+                    wm = int.from_bytes(wm_raw, "big") if wm_raw else None
+                    if prev_raw is not None:
+                        for x in range(
+                            max(1, cyc_now - ATTENDANCE_SETTLE_LOOKBACK + 1),
+                            cyc_now + 1,
+                        ):
+                            cyc_key = write_u64(x)
+                            if ctx.sget(
+                                STAKING_ADDRESS, b"att_done:" + cyc_key
+                            ) or ctx.sget(
+                                STAKING_ADDRESS, b"att_pubs:" + cyc_key
+                            ):
+                                continue
+                            if wm is not None:
+                                if x <= wm:
+                                    continue  # settled pre-cleanup
+                            elif x != cyc_now and not ctx.sget(
+                                STAKING_ADDRESS, b"att_checkin:" + cyc_key
+                            ):
+                                continue  # pre-watermark transition
+                            ctx.sput(
+                                STAKING_ADDRESS,
+                                b"att_pubs:" + cyc_key,
+                                prev_raw,
+                            )
                     out_keys = PublicConsensusKeys.decode(outgoing)
                     ctx.sput(
                         STAKING_ADDRESS,

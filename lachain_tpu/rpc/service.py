@@ -996,16 +996,28 @@ class RpcService:
         except JsonRpcError:
             return True
 
+    def _password_matches(self, candidate) -> bool:
+        # constant-time: this is an RPC-reachable oracle. Compare fixed-width
+        # digests, not the raw strings — compare_digest short-circuits on
+        # length mismatch, which would leak the password length
+        import hashlib
+        import hmac
+
+        return hmac.compare_digest(
+            hashlib.sha256(str(candidate).encode()).digest(),
+            hashlib.sha256(self.node.wallet._password.encode()).digest(),
+        )
+
     def fe_unlock(self, password, seconds="0x12c"):
         import time
 
-        if password != self.node.wallet._password:
+        if not self._password_matches(password):
             return False
         self._unlocked_until = time.time() + min(_unhex(seconds), 86400)
         return True
 
     def fe_changePassword(self, current, new):
-        if current != self.node.wallet._password:
+        if not self._password_matches(current):
             return False
         self.node.wallet.set_password(new)
         if self.node.wallet.path:

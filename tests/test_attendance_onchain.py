@@ -307,3 +307,98 @@ def test_status_manager_drives_detection(chain):
     assert not any(
         inv.startswith(sc.SEL_FINISH_ATTENDANCE) for _, inv in sent
     )
+
+
+def test_orphaned_cycle_settles_lazily(chain):
+    """ADVICE r4: a cycle whose close tx never lands before the cycle ends
+    must not orphan its check-in/vote state — the next cycle's finish sweeps
+    it, judging it against the electorate it actually voted with."""
+    from lachain_tpu.core.execution import get_balance
+    from lachain_tpu.core.types import TransactionReceipt
+
+    node, pub, privs, addrs, produce = chain
+    pubs = list(pub.ecdsa_pub_keys)
+    reward_share = sc.ATTENDANCE_CYCLE_REWARD // 4
+
+    # cycle 1: everyone reports full attendance inside the window...
+    while node.block_manager.current_height() < CYCLE:
+        produce([])
+    counts = {pk: 18 for pk in pubs}
+    for i in range(4):
+        produce([_report_tx(privs[i].ecdsa_priv, 0, pubs, counts)])
+    # ...but NO finish tx lands in cycle 1; roll straight into cycle 2's
+    # post-window blocks
+    while node.block_manager.current_height() < 2 * CYCLE + ATT_WINDOW:
+        produce([])
+    cyc1 = (1).to_bytes(8, "big")
+    assert _storage(node, b"att_checkin:" + cyc1) is not None
+    assert _storage(node, b"att_done:" + cyc1) is None
+
+    before = [get_balance(node.state.new_snapshot(), a) for a in addrs]
+    blk = produce([_plain_tx(privs[1].ecdsa_priv, 1, sc.SEL_FINISH_ATTENDANCE)])
+    rec = TransactionReceipt.decode(
+        node.block_manager.receipt_by_hash(blk.tx_hashes[0])
+    )
+    assert rec.status == 1
+    after = [get_balance(node.state.new_snapshot(), a) for a in addrs]
+
+    # cycle 1 settled late (in order, BEFORE cycle 2): median-18 rewards
+    # paid out, state swept. cycle 2 then settled in the same tx with zero
+    # check-ins, so every validator also accrued a no-show share-sized
+    # penalty for it (no cycle-2 reward to burn it against).
+    assert _storage(node, b"att_done:" + cyc1) is not None
+    assert _storage(node, b"att_checkin:" + cyc1) is None
+    assert _storage(node, b"att_done:" + (2).to_bytes(8, "big")) is not None
+    cyc1_reward = reward_share * 18 // CYCLE
+    for i in range(4):
+        fee = 21000 if i == 1 else 0
+        assert after[i] - before[i] == cyc1_reward - fee
+        pen = int.from_bytes(_storage(node, b"penalty:" + addrs[i]), "big")
+        assert pen == reward_share
+
+    # idempotent: a second finish in the same window is a no-op
+    b2 = produce([_plain_tx(privs[1].ecdsa_priv, 2, sc.SEL_FINISH_ATTENDANCE)])
+    rec2 = TransactionReceipt.decode(
+        node.block_manager.receipt_by_hash(b2.tx_hashes[0])
+    )
+    assert rec2.status == 0
+
+
+def test_fully_stalled_cycle_still_penalized(chain):
+    """Review finding: a cycle where NOBODY checked in and no finish landed
+    (all validators offline — the exact case penalties exist for) must still
+    hand out no-show penalties once the chain recovers. The att_settled
+    watermark makes 'no state at all' distinguishable from 'settled and
+    cleaned'."""
+    from lachain_tpu.core.types import TransactionReceipt
+
+    node, pub, privs, addrs, produce = chain
+    pubs = list(pub.ecdsa_pub_keys)
+    reward_share = sc.ATTENDANCE_CYCLE_REWARD // 4
+
+    # establish the watermark: settle cycle 1 normally (zero check-ins too,
+    # but settled IN-cycle so everyone gets a no-show penalty immediately)
+    while node.block_manager.current_height() < CYCLE + ATT_WINDOW:
+        produce([])
+    produce([_plain_tx(privs[0].ecdsa_priv, 0, sc.SEL_FINISH_ATTENDANCE)])
+    assert _storage(node, b"att_settled") == (1).to_bytes(8, "big")
+    pen1 = int.from_bytes(_storage(node, b"penalty:" + addrs[0]), "big")
+    assert pen1 == reward_share
+
+    # cycle 2 fully stalls: no submissions, no finish, no rotation — no
+    # attendance state of any kind is left behind
+    while node.block_manager.current_height() < 3 * CYCLE + ATT_WINDOW:
+        produce([])
+    assert _storage(node, b"att_checkin:" + (2).to_bytes(8, "big")) is None
+    assert _storage(node, b"att_pubs:" + (2).to_bytes(8, "big")) is None
+
+    # recovery in cycle 3: one finish settles stalled cycle 2 AND cycle 3
+    blk = produce([_plain_tx(privs[0].ecdsa_priv, 1, sc.SEL_FINISH_ATTENDANCE)])
+    rec = TransactionReceipt.decode(
+        node.block_manager.receipt_by_hash(blk.tx_hashes[0])
+    )
+    assert rec.status == 1
+    assert _storage(node, b"att_settled") == (3).to_bytes(8, "big")
+    pen = int.from_bytes(_storage(node, b"penalty:" + addrs[0]), "big")
+    # three no-show cycles accrued: 1 (in-cycle), 2 (stalled, lazy), 3
+    assert pen == 3 * reward_share

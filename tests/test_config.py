@@ -67,7 +67,13 @@ def test_round4_migrations_v3_to_v6():
     assert out["network"]["advertiseHost"] is None
     # scaled to the config's own short cycle (50 // 5), never >= the cycle
     assert out["staking"]["attendanceDetectionDuration"] == 10
-    assert out["hardfork"]["heights"]["fast_wasm_gas"] == 0
+    # migrated configs belong to chains that ran the OLD gas schedule:
+    # silently activating from genesis would retroactively reprice history
+    # and break resync validation, so the default is the NEVER sentinel
+    # until the operator schedules a real activation height
+    from lachain_tpu.core.config import HARDFORK_HEIGHT_NEVER
+
+    assert out["hardfork"]["heights"]["fast_wasm_gas"] == HARDFORK_HEIGHT_NEVER
     # values an operator already set are never clobbered
     v5 = {
         "version": 5,
