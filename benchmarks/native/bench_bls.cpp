@@ -1,0 +1,90 @@
+// Microbenchmark for the native BLS12-381 backend primitives.
+// Includes the implementation TU directly so static internals are timeable.
+#include "../../lachain_tpu/crypto/native/bls381.cpp"
+
+#include <chrono>
+#include <cstdio>
+
+static double now_ms() {
+  using namespace std::chrono;
+  return duration<double, std::milli>(steady_clock::now().time_since_epoch())
+      .count();
+}
+
+template <typename F>
+static double time_ms(int iters, F &&fn) {
+  double best = 1e30;
+  for (int rep = 0; rep < 3; rep++) {
+    double t0 = now_ms();
+    for (int i = 0; i < iters; i++) fn(i);
+    double dt = (now_ms() - t0) / iters;
+    if (dt < best) best = dt;
+  }
+  return best;
+}
+
+int main() {
+
+  // deterministic pseudo-random field elements / points
+  Fp a, b;
+  memset(&a, 0, sizeof a);
+  memset(&b, 0, sizeof b);
+  a.v[0] = 0x123456789abcdefull; a.v[3] = 77; 
+  b.v[0] = 0xfedcba987654321ull; b.v[2] = 13;
+  volatile u64 sink = 0;
+
+  const int N = 1000000;
+  Fp z;
+  double t_mul = time_ms(N, [&](int) { fp_mul(z, a, b); a.v[0] ^= z.v[0]; });
+  sink += z.v[0];
+  double t_sqr = time_ms(N, [&](int) { fp_sqr(z, a); a.v[1] ^= z.v[1]; });
+  sink += z.v[0];
+  Fp2 fa, fb, fz;
+  fa.c0 = a; fa.c1 = b; fb.c0 = b; fb.c1 = a;
+  double t2_mul = time_ms(N / 2, [&](int) { fp2_mul(fz, fa, fb); fa.c0.v[0] ^= fz.c0.v[0]; });
+  double t2_sqr = time_ms(N / 2, [&](int) { fp2_sqr(fz, fa); fa.c1.v[1] ^= fz.c1.v[1]; });
+  sink += fz.c0.v[0];
+
+  // real points: hash-to-curve
+  uint8_t g1buf[96], g2buf[192];
+  lt_hash_to_g1((const uint8_t *)"bench-p", 7, (const uint8_t *)"d", 1, g1buf);
+  lt_hash_to_g2((const uint8_t *)"bench-q", 7, (const uint8_t *)"d", 1, g2buf);
+  G1 P; G2 Q;
+  g1_from_bytes(P, g1buf);
+  g2_from_bytes(Q, g2buf);
+
+  Fp12 f;
+  double t_ml = time_ms(200, [&](int) { miller_loop(f, P, Q); });
+  Fp12 e;
+  double t_fe = time_ms(200, [&](int) { final_exponentiation(e, f); });
+
+  // g1 deserialize (the wire-parse hot path)
+  double t_des = time_ms(2000, [&](int) { G1 t; g1_from_bytes(t, g1buf); });
+  double t_sub = time_ms(2000, [&](int) { sink += g1_in_subgroup(P); });
+
+  // 22-point G1 MSM (Lagrange-combine shape at N=64, t+1=22)
+  {
+    const size_t n = 22;
+    std::vector<uint8_t> pts(n * 96), scs(n * 32);
+    for (size_t i = 0; i < n; i++) {
+      char m[16]; int L = snprintf(m, sizeof m, "msm%zu", i);
+      lt_hash_to_g1((const uint8_t *)m, L, (const uint8_t *)"d", 1, pts.data() + i * 96);
+      for (int j = 0; j < 32; j++) scs[i * 32 + j] = (uint8_t)(i * 37 + j * 11 + 1);
+    }
+    uint8_t out[96];
+    double t_msm = time_ms(100, [&](int) { lt_g1_msm(pts.data(), scs.data(), n, out); });
+    printf("g1_msm22_ms %.4f\n", t_msm);
+  }
+
+  printf("fp_mul_ns %.1f\n", t_mul * 1e6);
+  printf("fp_sqr_ns %.1f\n", t_sqr * 1e6);
+  printf("fp2_mul_ns %.1f\n", t2_mul * 1e6);
+  printf("fp2_sqr_ns %.1f\n", t2_sqr * 1e6);
+  printf("miller_ms %.4f\n", t_ml);
+  printf("final_exp_ms %.4f\n", t_fe);
+  printf("pairing_ms %.4f\n", t_ml + t_fe);
+  printf("g1_deser_ms %.4f\n", t_des);
+  printf("g1_subgroup_ms %.4f\n", t_sub);
+  printf("sink %llu\n", (unsigned long long)sink);
+  return 0;
+}

@@ -65,14 +65,18 @@ static inline int cmp_limbs(const u64 *a, const u64 *b, int n) {
 }
 
 static inline void sub_p_if_ge(u64 *t) {  // t has 6 limbs, t < 2p
-  if (cmp_limbs(t, P_LIMBS, 6) >= 0) {
-    u128 borrow = 0;
-    for (int i = 0; i < 6; i++) {
-      u128 cur = (u128)t[i] - P_LIMBS[i] - (u64)borrow;
-      t[i] = (u64)cur;
-      borrow = (cur >> 64) ? 1 : 0;
-    }
+  // BRANCHLESS: the compare-then-subtract was a data-dependent branch on
+  // the hottest helper in the library (~50% mispredict on random values);
+  // compute t - p unconditionally and mask-select on the borrow.
+  u64 s[6];
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 cur = (u128)t[i] - P_LIMBS[i] - (u64)borrow;
+    s[i] = (u64)cur;
+    borrow = (cur >> 64) ? 1 : 0;
   }
+  u64 keep = (u64)0 - (u64)borrow;  // all-ones if t < p (keep t)
+  for (int i = 0; i < 6; i++) t[i] = (t[i] & keep) | (s[i] & ~keep);
 }
 
 static inline void fp_add(Fp &z, const Fp &a, const Fp &b) {
@@ -96,13 +100,14 @@ static inline void fp_sub(Fp &z, const Fp &a, const Fp &b) {
     t[i] = (u64)cur;
     borrow = (cur >> 64) ? 1 : 0;
   }
-  if (borrow) {
-    u128 carry = 0;
-    for (int i = 0; i < 6; i++) {
-      u128 cur = (u128)t[i] + P_LIMBS[i] + (u64)carry;
-      t[i] = (u64)cur;
-      carry = cur >> 64;
-    }
+  // branchless: add p back masked by the borrow (data-dependent branch
+  // mispredicts ~50% on random inputs)
+  u64 mask = (u64)0 - (u64)borrow;
+  u128 carry = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 cur = (u128)t[i] + (P_LIMBS[i] & mask) + (u64)carry;
+    t[i] = (u64)cur;
+    carry = cur >> 64;
   }
   memcpy(z.v, t, sizeof(t));
 }
@@ -120,8 +125,154 @@ static inline void fp_neg(Fp &z, const Fp &a) {
   }
 }
 
-// CIOS Montgomery multiplication.
-static void fp_mul(Fp &z, const Fp &a, const Fp &b) {
+// ---------------------------------------------------------------------------
+// ADX/BMI2 Montgomery multiplication (the MCL/blst-class hot path).
+//
+// Interleaved operand-scanning CIOS with DUAL carry chains: mulx keeps CF/OF
+// untouched, so the lo-limb additions ride the OF chain (adox) while the
+// hi-limb additions ride the CF chain (adcx) — the two chains retire in
+// parallel and the round is mulx-throughput-bound (~12 mulx/round, 6 rounds).
+// Register scheme: the 7-limb accumulator lives in r8..r14 and ROTATES one
+// position per round (phase B's shift-by-one-limb is free renaming; the
+// freshly-zeroed low limb becomes the next round's top limb).
+//
+// Guarded by a start-up differential self-check against the portable CIOS
+// below (fp_mul_c); any mismatch keeps the portable path (HAVE_ADX=false).
+#if defined(__x86_64__) && defined(__ADX__) && defined(__BMI2__)
+#define LT_HAVE_ADX_BUILD 1
+
+// round phase A: t(T0..T5) += a_i * b;  7th limb into T6 (must enter 0)
+#define LT_MUL_ROUND_A(i, T0, T1, T2, T3, T4, T5, T6)                       \
+  "movq " #i "*8(%rsi), %rdx\n\t"                                           \
+  "xorl %eax, %eax\n\t" /* clear CF+OF */                                   \
+  "mulxq 0(%rcx), %rax, %rbp\n\t"                                           \
+  "adoxq %rax, " T0 "\n\t"                                                  \
+  "mulxq 8(%rcx), %rax, %r15\n\t"                                           \
+  "adcxq %rbp, " T1 "\n\t"                                                  \
+  "adoxq %rax, " T1 "\n\t"                                                  \
+  "mulxq 16(%rcx), %rax, %rbp\n\t"                                          \
+  "adcxq %r15, " T2 "\n\t"                                                  \
+  "adoxq %rax, " T2 "\n\t"                                                  \
+  "mulxq 24(%rcx), %rax, %r15\n\t"                                          \
+  "adcxq %rbp, " T3 "\n\t"                                                  \
+  "adoxq %rax, " T3 "\n\t"                                                  \
+  "mulxq 32(%rcx), %rax, %rbp\n\t"                                          \
+  "adcxq %r15, " T4 "\n\t"                                                  \
+  "adoxq %rax, " T4 "\n\t"                                                  \
+  "mulxq 40(%rcx), %rax, %r15\n\t"                                          \
+  "adcxq %rbp, " T5 "\n\t"                                                  \
+  "adoxq %rax, " T5 "\n\t"                                                  \
+  "movl $0, %eax\n\t"                                                       \
+  "adcxq %r15, " T6 "\n\t"                                                  \
+  "adoxq %rax, " T6 "\n\t"
+
+// round phase B: m = T0*PINV; t += m*p; logical >>64 (T0 becomes 0 and is
+// the caller's next-round T6)
+#define LT_MUL_ROUND_B(T0, T1, T2, T3, T4, T5, T6)                          \
+  "movq " T0 ", %rdx\n\t"                                                   \
+  "imulq lt_adx_pinv(%rip), %rdx\n\t"                                       \
+  "xorl %eax, %eax\n\t"                                                     \
+  "mulxq lt_adx_p(%rip), %rax, %rbp\n\t"                                    \
+  "adcxq %rax, " T0 "\n\t" /* T0 -> 0 */                                    \
+  "mulxq lt_adx_p+8(%rip), %rax, %r15\n\t"                                  \
+  "adcxq %rbp, " T1 "\n\t"                                                  \
+  "adoxq %rax, " T1 "\n\t"                                                  \
+  "mulxq lt_adx_p+16(%rip), %rax, %rbp\n\t"                                 \
+  "adcxq %r15, " T2 "\n\t"                                                  \
+  "adoxq %rax, " T2 "\n\t"                                                  \
+  "mulxq lt_adx_p+24(%rip), %rax, %r15\n\t"                                 \
+  "adcxq %rbp, " T3 "\n\t"                                                  \
+  "adoxq %rax, " T3 "\n\t"                                                  \
+  "mulxq lt_adx_p+32(%rip), %rax, %rbp\n\t"                                 \
+  "adcxq %r15, " T4 "\n\t"                                                  \
+  "adoxq %rax, " T4 "\n\t"                                                  \
+  "mulxq lt_adx_p+40(%rip), %rax, %r15\n\t"                                 \
+  "adcxq %rbp, " T5 "\n\t"                                                  \
+  "adoxq %rax, " T5 "\n\t"                                                  \
+  "movl $0, %eax\n\t"                                                       \
+  "adcxq %r15, " T6 "\n\t"                                                  \
+  "adoxq %rax, " T6 "\n\t"
+
+#define LT_MUL_ROUND(i, T0, T1, T2, T3, T4, T5, T6)                         \
+  LT_MUL_ROUND_A(i, T0, T1, T2, T3, T4, T5, T6)                             \
+  LT_MUL_ROUND_B(T0, T1, T2, T3, T4, T5, T6)
+
+__asm__(
+    ".section .rodata\n\t"
+    ".balign 64\n"
+    "lt_adx_p:\n\t"
+    ".quad 0xb9feffffffffaaab, 0x1eabfffeb153ffff, 0x6730d2a0f6b0f624\n\t"
+    ".quad 0x64774b84f38512bf, 0x4b1ba7b6434bacd7, 0x1a0111ea397fe69a\n"
+    "lt_adx_pinv:\n\t"
+    ".quad 0x89f3fffcfffcfffd\n\t"
+    ".text\n\t"
+    ".globl lt_fp_mul_adx\n\t"
+    ".hidden lt_fp_mul_adx\n\t"
+    ".type lt_fp_mul_adx,@function\n\t"
+    ".balign 32\n"
+    "lt_fp_mul_adx:\n\t"
+    // rdi = z, rsi = a, rdx = b
+    "pushq %rbp\n\t"
+    "pushq %r12\n\t"
+    "pushq %r13\n\t"
+    "pushq %r14\n\t"
+    "pushq %r15\n\t"
+    "movq %rdx, %rcx\n\t"
+    "xorl %r8d, %r8d\n\t"
+    "xorl %r9d, %r9d\n\t"
+    "xorl %r10d, %r10d\n\t"
+    "xorl %r11d, %r11d\n\t"
+    "xorl %r12d, %r12d\n\t"
+    "xorl %r13d, %r13d\n\t"
+    "xorl %r14d, %r14d\n\t"
+    // clang-format off
+    LT_MUL_ROUND(0, "%r8",  "%r9",  "%r10", "%r11", "%r12", "%r13", "%r14")
+    LT_MUL_ROUND(1, "%r9",  "%r10", "%r11", "%r12", "%r13", "%r14", "%r8")
+    LT_MUL_ROUND(2, "%r10", "%r11", "%r12", "%r13", "%r14", "%r8",  "%r9")
+    LT_MUL_ROUND(3, "%r11", "%r12", "%r13", "%r14", "%r8",  "%r9",  "%r10")
+    LT_MUL_ROUND(4, "%r12", "%r13", "%r14", "%r8",  "%r9",  "%r10", "%r11")
+    LT_MUL_ROUND(5, "%r13", "%r14", "%r8",  "%r9",  "%r10", "%r11", "%r12")
+    // clang-format on
+    // result t0..t5 = r14, r8, r9, r10, r11, r12 (< 2p); subtract p if >= p
+    "movq %r14, %rax\n\t"
+    "movq %r8,  %rcx\n\t"
+    "movq %r9,  %rdx\n\t"
+    "movq %r10, %rsi\n\t"
+    "movq %r11, %r15\n\t"
+    "movq %r12, %r13\n\t"
+    "subq lt_adx_p+0(%rip),  %rax\n\t"
+    "sbbq lt_adx_p+8(%rip),  %rcx\n\t"
+    "sbbq lt_adx_p+16(%rip), %rdx\n\t"
+    "sbbq lt_adx_p+24(%rip), %rsi\n\t"
+    "sbbq lt_adx_p+32(%rip), %r15\n\t"
+    "sbbq lt_adx_p+40(%rip), %r13\n\t"
+    "cmovcq %r14, %rax\n\t"
+    "cmovcq %r8,  %rcx\n\t"
+    "cmovcq %r9,  %rdx\n\t"
+    "cmovcq %r10, %rsi\n\t"
+    "cmovcq %r11, %r15\n\t"
+    "cmovcq %r12, %r13\n\t"
+    "movq %rax, 0(%rdi)\n\t"
+    "movq %rcx, 8(%rdi)\n\t"
+    "movq %rdx, 16(%rdi)\n\t"
+    "movq %rsi, 24(%rdi)\n\t"
+    "movq %r15, 32(%rdi)\n\t"
+    "movq %r13, 40(%rdi)\n\t"
+    "popq %r15\n\t"
+    "popq %r14\n\t"
+    "popq %r13\n\t"
+    "popq %r12\n\t"
+    "popq %rbp\n\t"
+    "ret\n\t"
+    ".size lt_fp_mul_adx, .-lt_fp_mul_adx\n\t");
+
+extern "C" void lt_fp_mul_adx(u64 *z, const u64 *a, const u64 *b);
+#endif  // __x86_64__ && __ADX__ && __BMI2__
+
+static bool HAVE_ADX = false;  // set by the init self-check
+
+// Portable CIOS Montgomery multiplication (also the self-check oracle).
+static void fp_mul_c(Fp &z, const Fp &a, const Fp &b) {
   u64 t[8];
   memset(t, 0, sizeof(t));
   for (int i = 0; i < 6; i++) {
@@ -152,6 +303,16 @@ static void fp_mul(Fp &z, const Fp &a, const Fp &b) {
   // t[0..5] < 2p (t[6] == 0 for BLS12-381's 381-bit p).
   sub_p_if_ge(t);
   memcpy(z.v, t, 48);
+}
+
+static inline void fp_mul(Fp &z, const Fp &a, const Fp &b) {
+#ifdef LT_HAVE_ADX_BUILD
+  if (HAVE_ADX) {
+    lt_fp_mul_adx(z.v, a.v, b.v);
+    return;
+  }
+#endif
+  fp_mul_c(z, a, b);
 }
 
 static inline void fp_sqr(Fp &z, const Fp &a) { fp_mul(z, a, a); }
@@ -844,6 +1005,352 @@ static void g2_to_affine(Fp2 &ax, Fp2 &ay, const G2 &p) {
   fp2_mul(ay, p.y, zi2);
 }
 
+// ===========================================================================
+// GLV + Straus small-MSM machinery (the Lagrange-combine hot path)
+//
+// The binary egcd inversion costs ~16us on this box, so EVERY to-affine
+// conversion in batch paths goes through Montgomery's batch-inversion trick
+// (one egcd + 3 muls/element) — g1_to_affine above is for singletons only.
+// ===========================================================================
+
+// |z| for BLS12-381 (z = -0xd201000000010000), Hamming weight 6: a scalar
+// ladder over it costs 64 doublings + 5 additions
+static const uint8_t Z_ABS_BE[8] = {0xd2, 0x01, 0x00, 0x00,
+                                    0x00, 0x01, 0x00, 0x00};
+// beta: the cube root of unity in Fp whose GLV endomorphism
+// phi(x, y) = (beta*x, y) acts as multiplication by lambda = z^2 - 1 on
+// G1 (beta = (2^((p-1)/3))^2; the OTHER root pairs with the other
+// eigenvalue — resolved empirically and pinned by the soundness
+// certificate, tests/test_subgroup_fast.py)
+static const uint8_t BETA_G1_BE[48] = {
+    0x1a, 0x01, 0x11, 0xea, 0x39, 0x7f, 0xe6, 0x99, 0xec, 0x02, 0x40, 0x86,
+    0x63, 0xd4, 0xde, 0x85, 0xaa, 0x0d, 0x85, 0x7d, 0x89, 0x75, 0x9a, 0xd4,
+    0x89, 0x7d, 0x29, 0x65, 0x0f, 0xb8, 0x5f, 0x9b, 0x40, 0x94, 0x27, 0xeb,
+    0x4f, 0x49, 0xff, 0xfd, 0x8b, 0xfd, 0x00, 0x00, 0x00, 0x00, 0xaa, 0xac};
+
+// Montgomery batch inversion: zs[i] <- zs[i]^{-1}; zero entries stay zero
+// (callers use Z==0 as the point-at-infinity marker).
+static void fp_batch_inv(Fp *zs, size_t n) {
+  if (n == 0) return;
+  std::vector<Fp> pre(n);
+  Fp acc = MONT_ONE;
+  for (size_t i = 0; i < n; i++) {
+    pre[i] = acc;
+    if (!fp_is_zero(zs[i])) fp_mul(acc, acc, zs[i]);
+  }
+  Fp inv;
+  fp_inv(inv, acc);
+  for (size_t i = n; i-- > 0;) {
+    if (fp_is_zero(zs[i])) continue;
+    Fp t;
+    fp_mul(t, inv, pre[i]);
+    fp_mul(inv, inv, zs[i]);
+    zs[i] = t;
+  }
+}
+
+// Batch Jacobian -> affine for n points with ONE field inversion; on
+// return (xs[i], ys[i]) is affine and valid[i]=false marks infinity.
+static void g1_batch_to_affine(const G1 *pts, Fp *xs, Fp *ys,
+                               uint8_t *valid, size_t n) {
+  std::vector<Fp> zs(n);
+  for (size_t i = 0; i < n; i++) zs[i] = pts[i].z;
+  fp_batch_inv(zs.data(), n);
+  for (size_t i = 0; i < n; i++) {
+    if (fp_is_zero(zs[i])) {
+      xs[i] = FP_ZERO;
+      ys[i] = FP_ZERO;
+      valid[i] = 0;
+      continue;
+    }
+    Fp zi2, zi3;
+    fp_sqr(zi2, zs[i]);
+    fp_mul(zi3, zi2, zs[i]);
+    fp_mul(xs[i], pts[i].x, zi2);
+    fp_mul(ys[i], pts[i].y, zi3);
+    valid[i] = 1;
+  }
+}
+
+// mixed addition r = p + (qx, qy) [affine q, q != inf] — madd-2007-bl
+// (7M + 4S vs the 11M + 5S full Jacobian add); handles p == +-q.
+static void g1_madd(G1 &r, const G1 &p, const Fp &qx, const Fp &qy) {
+  if (g1_is_inf(p)) {
+    r.x = qx;
+    r.y = qy;
+    r.z = MONT_ONE;
+    return;
+  }
+  Fp z1z1, u2, s2, t;
+  fp_sqr(z1z1, p.z);
+  fp_mul(u2, qx, z1z1);
+  fp_mul(t, qy, p.z);
+  fp_mul(s2, t, z1z1);
+  if (fp_eq(p.x, u2)) {
+    if (fp_eq(p.y, s2)) {
+      g1_dbl(r, p);
+      return;
+    }
+    r = G1_INF_;
+    return;
+  }
+  Fp h, hh, i, j, rr, v, x3, y3, z3;
+  fp_sub(h, u2, p.x);
+  fp_sqr(hh, h);
+  fp_dbl(i, hh);
+  fp_dbl(i, i);
+  fp_mul(j, h, i);
+  fp_sub(rr, s2, p.y);
+  fp_dbl(rr, rr);
+  fp_mul(v, p.x, i);
+  fp_sqr(x3, rr);
+  fp_sub(x3, x3, j);
+  fp_sub(x3, x3, v);
+  fp_sub(x3, x3, v);
+  fp_sub(t, v, x3);
+  fp_mul(y3, rr, t);
+  fp_mul(t, p.y, j);
+  fp_dbl(t, t);
+  fp_sub(y3, y3, t);
+  fp_add(z3, p.z, h);
+  fp_sqr(z3, z3);
+  fp_sub(z3, z3, z1z1);
+  fp_sub(z3, z3, hh);
+  r.x = x3;
+  r.y = y3;
+  r.z = z3;
+}
+
+// LE-limb schoolbook multiply, out must hold na+nb limbs
+static void limbs_mul(u64 *out, const u64 *a, int na, const u64 *b, int nb) {
+  memset(out, 0, 8 * (size_t)(na + nb));
+  for (int i = 0; i < na; i++) {
+    u64 carry = 0;
+    for (int j = 0; j < nb; j++) {
+      u128 cur = (u128)a[i] * b[j] + out[i + j] + carry;
+      out[i + j] = (u64)cur;
+      carry = (u64)(cur >> 64);
+    }
+    out[i + nb] = carry;  // untouched by earlier rounds
+  }
+}
+
+// GLV decomposition constants (filled in by Init)
+static u64 MU384[3];      // floor(2^384 / r) — Barrett
+static u64 Z2_LIMBS[2];   // z^2      (lambda + 1)
+static u64 LAM_LIMBS[2];  // lambda = z^2 - 1 (phi eigenvalue on G1)
+
+// reduce a 32-byte BE scalar mod r into 4 LE limbs (k < 2^256 < 4r)
+static void scalar_mod_r(u64 k[4], const uint8_t be[32]) {
+  for (int i = 0; i < 4; i++) {
+    u64 l = 0;
+    for (int j = 0; j < 8; j++) l = (l << 8) | be[(3 - i) * 8 + j];
+    k[i] = l;
+  }
+  for (int rep = 0; rep < 3; rep++) {
+    u64 t[4];
+    memcpy(t, k, 32);
+    if (!limbs_sub(t, R_LIMBS, 4)) memcpy(k, t, 32);  // k >= r: keep k-r
+  }
+}
+
+// k (mod r) ->  s1*a1 + lambda * s2*a2  with |ai| < 2^131.
+// Unconditionally SOUND: the split is re-verified against k mod r and falls
+// back to the trivial (k, 0) decomposition on any Barrett corner case, so
+// callers never depend on the rounding-error analysis.
+static void glv_split_g1(int &s1, u64 a1[4], int &s2, u64 a2[4],
+                         const u64 k[4]) {
+  // c1 ~= k*z^2/r, c2 ~= k/r (both floor approximations, error <= 2)
+  u64 kz2[6], t9[9], t7[7];
+  limbs_mul(kz2, k, 4, Z2_LIMBS, 2);
+  limbs_mul(t9, kz2, 6, MU384, 3);
+  u64 c1[3] = {t9[6], t9[7], t9[8]};
+  limbs_mul(t7, k, 4, MU384, 3);
+  u64 c2 = t7[6];  // k/r < 4
+  // k1 = k - c1*lambda - c2 (5-limb two's complement)
+  u64 c1l[5], k1[5] = {k[0], k[1], k[2], k[3], 0};
+  limbs_mul(c1l, c1, 3, LAM_LIMBS, 2);
+  bool neg1 = limbs_sub(k1, c1l, 5);
+  u64 c2w[5] = {c2, 0, 0, 0, 0};
+  if (limbs_sub(k1, c2w, 5)) neg1 = true;
+  if (neg1) {  // negate two's complement
+    for (int i = 0; i < 5; i++) k1[i] = ~k1[i];
+    u64 one[5] = {1, 0, 0, 0, 0};
+    limbs_add(k1, one, 5);
+  }
+  // k2 = c1 - c2*z^2 (5-limb two's complement)
+  u64 k2[5] = {c1[0], c1[1], c1[2], 0, 0}, c2z[5];
+  u64 c2l[1] = {c2};
+  limbs_mul(c2z, c2l, 1, Z2_LIMBS, 2);
+  c2z[3] = c2z[4] = 0;
+  bool neg2 = limbs_sub(k2, c2z, 5);
+  if (neg2) {
+    for (int i = 0; i < 5; i++) k2[i] = ~k2[i];
+    u64 one[5] = {1, 0, 0, 0, 0};
+    limbs_add(k2, one, 5);
+  }
+  s1 = neg1 ? -1 : 1;
+  s2 = neg2 ? -1 : 1;
+  memcpy(a1, k1, 32);
+  memcpy(a2, k2, 32);
+  // soundness re-check: s1*a1 + lambda*s2*a2 == k (mod r)?
+  // rhs = a1*?; work mod r via repeated conditional subtraction after
+  // reducing the 6-limb lambda*a2 product with the generic path.
+  bool ok = k1[4] == 0 && k2[4] == 0 && (a1[3] >> 8) == 0 && (a2[3] >> 8) == 0;
+  if (ok) {
+    // r1 = a1 mod r, r2 = (lambda * a2) mod r  (product < 2^128 * 2^131)
+    u64 la2[6];
+    limbs_mul(la2, a2, 4, LAM_LIMBS, 2);
+    // reduce la2 (6 limbs) mod r by Barrett with MU384: q = (la2*MU)>>384
+    u64 q9[9];
+    limbs_mul(q9, la2, 6, MU384, 3);
+    u64 q[3] = {q9[6], q9[7], q9[8]};
+    u64 qr[7];
+    limbs_mul(qr, q, 3, R_LIMBS, 4);
+    u64 la2w[7] = {la2[0], la2[1], la2[2], la2[3], la2[4], la2[5], 0};
+    limbs_sub(la2w, qr, 7);
+    for (int rep = 0; rep < 4; rep++) {
+      u64 t[7];
+      memcpy(t, la2w, 56);
+      u64 rw[7] = {R_LIMBS[0], R_LIMBS[1], R_LIMBS[2], R_LIMBS[3], 0, 0, 0};
+      if (!limbs_sub(t, rw, 7)) memcpy(la2w, t, 56);
+    }
+    // acc = s1*a1 + s2*la2w mod r, then compare against k
+    u64 acc[5] = {0, 0, 0, 0, 0};
+    u64 a1w[5] = {a1[0], a1[1], a1[2], a1[3], 0};
+    u64 l2w[5] = {la2w[0], la2w[1], la2w[2], la2w[3], 0};
+    u64 rw[5] = {R_LIMBS[0], R_LIMBS[1], R_LIMBS[2], R_LIMBS[3], 0};
+    if (s1 > 0) limbs_add(acc, a1w, 5);
+    else if (limbs_sub(acc, a1w, 5)) limbs_add(acc, rw, 5), limbs_add(acc, rw, 5);
+    if (s2 > 0) limbs_add(acc, l2w, 5);
+    else if (limbs_sub(acc, l2w, 5)) limbs_add(acc, rw, 5), limbs_add(acc, rw, 5);
+    for (int rep = 0; rep < 4; rep++) {
+      u64 t[5];
+      memcpy(t, acc, 40);
+      if (!limbs_sub(t, rw, 5)) memcpy(acc, t, 40);
+    }
+    ok = acc[4] == 0 && acc[0] == k[0] && acc[1] == k[1] && acc[2] == k[2] &&
+         acc[3] == k[3];
+  }
+  if (!ok) {  // fall back to the trivial decomposition (always correct)
+    s1 = 1;
+    s2 = 1;
+    memcpy(a1, k, 32);
+    memset(a2, 0, 32);
+  }
+}
+
+// width-4 NAF of a (LE limbs, destructive); digits odd in {+-1,+-3,+-5,+-7};
+// returns digit count (<= 64*nlimbs + 1)
+static int wnaf4(int8_t *digits, u64 *a, int nlimbs) {
+  int len = 0;
+  while (!limbs_is_zero(a, nlimbs)) {
+    int d = 0;
+    if (a[0] & 1) {
+      d = (int)(a[0] & 15);
+      if (d > 8) d -= 16;
+      if (d > 0) {
+        u64 borrow = (u64)d;
+        for (int i = 0; i < nlimbs && borrow; i++) {
+          u64 prev = a[i];
+          a[i] -= borrow;
+          borrow = a[i] > prev ? 1 : 0;
+        }
+      } else {
+        u64 carry = (u64)(-d);
+        for (int i = 0; i < nlimbs && carry; i++) {
+          u64 prev = a[i];
+          a[i] += carry;
+          carry = a[i] < prev ? 1 : 0;
+        }
+      }
+    }
+    digits[len++] = (int8_t)d;
+    limbs_rshift1(a, nlimbs);
+  }
+  return len;
+}
+
+// Straus/GLV MSM over G1 for SMALL n (the Lagrange-combine shape: t+1
+// points). Each 255-bit scalar splits into two ~129-bit GLV halves (the
+// phi half's affine table is the base table with x scaled by beta — phi is
+// a homomorphism, so phi(mP) = m*phi(P)); both halves run width-4 NAF over
+// a batch-normalized affine table with mixed additions. ~4x over the
+// bucket method at n=22 (which cannot amortize buckets at this size).
+static void g1_msm_straus(G1 &out, const G1 *points, const uint8_t *scalars,
+                          size_t n) {
+  const int TBL = 4;  // odd multiples 1,3,5,7
+  struct Half {
+    int tbl;      // index into the affine tables (j*TBL)
+    bool phi;     // use the beta-scaled x
+    int8_t digits[260];  // split halves are ~132; the sound fallback
+    int len;             // decomposition runs the full 256-bit scalar
+  };
+  std::vector<Fp> tx(n * TBL), ty(n * TBL), phix(n * TBL);
+  std::vector<uint8_t> tvalid(n * TBL);
+  std::vector<Half> halves(2 * n);
+  // Jacobian odd-multiple tables
+  std::vector<G1> jt(n * TBL);
+  for (size_t j = 0; j < n; j++) {
+    const G1 &p = points[j];
+    jt[j * TBL] = p;
+    G1 twop;
+    g1_dbl(twop, p);
+    g1_add(jt[j * TBL + 1], twop, p);
+    g1_add(jt[j * TBL + 2], jt[j * TBL + 1], twop);
+    g1_add(jt[j * TBL + 3], jt[j * TBL + 2], twop);
+  }
+  g1_batch_to_affine(jt.data(), tx.data(), ty.data(), tvalid.data(),
+                     n * TBL);
+  Fp beta;
+  fp_from_bytes_be(beta, BETA_G1_BE);
+  for (size_t i = 0; i < n * TBL; i++)
+    if (tvalid[i]) fp_mul(phix[i], tx[i], beta);
+  // scalar split + wNAF
+  int maxlen = 0;
+  for (size_t j = 0; j < n; j++) {
+    u64 k[4];
+    scalar_mod_r(k, scalars + j * 32);
+    int s1, s2;
+    u64 a1[4], a2[4];
+    glv_split_g1(s1, a1, s2, a2, k);
+    Half &h1 = halves[2 * j], &h2 = halves[2 * j + 1];
+    h1.tbl = (int)(j * TBL);
+    h1.phi = false;
+    h1.len = wnaf4(h1.digits, a1, 4);
+    if (s1 < 0)
+      for (int i = 0; i < h1.len; i++) h1.digits[i] = -h1.digits[i];
+    h2.tbl = (int)(j * TBL);
+    h2.phi = true;
+    h2.len = wnaf4(h2.digits, a2, 4);
+    if (s2 < 0)
+      for (int i = 0; i < h2.len; i++) h2.digits[i] = -h2.digits[i];
+    if (h1.len > maxlen) maxlen = h1.len;
+    if (h2.len > maxlen) maxlen = h2.len;
+  }
+  G1 acc = G1_INF_;
+  for (int pos = maxlen - 1; pos >= 0; pos--) {
+    g1_dbl(acc, acc);
+    for (size_t h = 0; h < 2 * n; h++) {
+      const Half &hf = halves[h];
+      if (pos >= hf.len) continue;
+      int d = hf.digits[pos];
+      if (!d) continue;
+      int idx = hf.tbl + (d > 0 ? d - 1 : -d - 1) / 2;
+      if (!tvalid[idx]) continue;  // infinity entry
+      const Fp &qx = hf.phi ? phix[idx] : tx[idx];
+      if (d > 0) {
+        g1_madd(acc, acc, qx, ty[idx]);
+      } else {
+        Fp ny;
+        fp_neg(ny, ty[idx]);
+        g1_madd(acc, acc, qx, ny);
+      }
+    }
+  }
+  out = acc;
+}
+
 // --- wire format (matches the Python oracle: BE uncompressed, zero == inf) --
 
 static bool g1_from_bytes(G1 &p, const uint8_t *in) {  // 96 bytes
@@ -944,21 +1451,6 @@ static bool g1_eq_proj(const G1 &p, const G1 &q) {
   fp_mul(b, q.y, z1c);
   return fp_eq(a, b);
 }
-
-// |z| for BLS12-381 (z = -0xd201000000010000), Hamming weight 6: a scalar
-// ladder over it costs 64 doublings + 5 additions
-static const uint8_t Z_ABS_BE[8] = {0xd2, 0x01, 0x00, 0x00,
-                                    0x00, 0x01, 0x00, 0x00};
-// beta: the cube root of unity in Fp whose GLV endomorphism
-// phi(x, y) = (beta*x, y) acts as multiplication by lambda = z^2 - 1 on
-// G1 (beta = (2^((p-1)/3))^2; the OTHER root pairs with the other
-// eigenvalue — resolved empirically and pinned by the soundness
-// certificate, tests/test_subgroup_fast.py)
-static const uint8_t BETA_G1_BE[48] = {
-    0x1a, 0x01, 0x11, 0xea, 0x39, 0x7f, 0xe6, 0x99, 0xec, 0x02, 0x40, 0x86,
-    0x63, 0xd4, 0xde, 0x85, 0xaa, 0x0d, 0x85, 0x7d, 0x89, 0x75, 0x9a, 0xd4,
-    0x89, 0x7d, 0x29, 0x65, 0x0f, 0xb8, 0x5f, 0x9b, 0x40, 0x94, 0x27, 0xeb,
-    0x4f, 0x49, 0xff, 0xfd, 0x8b, 0xfd, 0x00, 0x00, 0x00, 0x00, 0xaa, 0xac};
 
 static bool g1_in_subgroup(const G1 &p) {
   // Certified fast membership test: P is in the prime-order subgroup iff
@@ -1137,6 +1629,51 @@ static void ml_init(MLState &s, const G1 &p, const G2 &q) {
   s.X = s.xQ;
   s.Y = s.yQ;
   s.Z = FP2_ONE_;
+}
+
+// Batch variant for the era-sized grand products: to-affine needs a field
+// inversion per point (~16us egcd each on this box — 4ms of pure inversion
+// at 128 pairs); Montgomery's trick folds ALL of them (G1 z's and the Fp
+// norms of G2 z's alike) into ONE egcd + 3 muls per element.
+static void ml_init_batch(MLState *states, const G1 *ps, const G2 *qs,
+                          size_t n) {
+  std::vector<Fp> invs(2 * n);
+  for (size_t i = 0; i < n; i++) {
+    states[i].inf = g1_is_inf(ps[i]) || g2_is_inf(qs[i]);
+    if (states[i].inf) {
+      invs[2 * i] = FP_ZERO;
+      invs[2 * i + 1] = FP_ZERO;
+      continue;
+    }
+    invs[2 * i] = ps[i].z;
+    // norm(z2) = c0^2 + c1^2; its inverse gives fp2 inverse via conjugate
+    Fp n0, n1;
+    fp_sqr(n0, qs[i].z.c0);
+    fp_sqr(n1, qs[i].z.c1);
+    fp_add(invs[2 * i + 1], n0, n1);
+  }
+  fp_batch_inv(invs.data(), 2 * n);
+  for (size_t i = 0; i < n; i++) {
+    MLState &s = states[i];
+    if (s.inf) continue;
+    Fp zi2;
+    fp_sqr(zi2, invs[2 * i]);
+    fp_mul(s.px, ps[i].x, zi2);
+    fp_mul(zi2, zi2, invs[2 * i]);
+    fp_mul(s.py, ps[i].y, zi2);
+    Fp2 z2i;  // (conj z) * norm^{-1}
+    fp_mul(z2i.c0, qs[i].z.c0, invs[2 * i + 1]);
+    fp_mul(z2i.c1, qs[i].z.c1, invs[2 * i + 1]);
+    fp_neg(z2i.c1, z2i.c1);
+    Fp2 zi2q;
+    fp2_sqr(zi2q, z2i);
+    fp2_mul(s.xQ, qs[i].x, zi2q);
+    fp2_mul(zi2q, zi2q, z2i);
+    fp2_mul(s.yQ, qs[i].y, zi2q);
+    s.X = s.xQ;
+    s.Y = s.yQ;
+    s.Z = FP2_ONE_;
+  }
 }
 
 // one doubling step of the shared-squaring Miller loop: accumulate this
@@ -1690,6 +2227,43 @@ static void compute_pinv() {
   PINV = (u64)(0 - x);
 }
 
+// Differential self-check for the ADX multiplication: drive both paths over
+// a pseudorandom walk plus the edge values (0, 1, R, p-1 in Montgomery
+// form); ANY mismatch keeps the portable path. Also pins the asm's baked-in
+// pinv constant against the computed one.
+static void adx_selfcheck() {
+#ifdef LT_HAVE_ADX_BUILD
+  if (PINV != 0x89f3fffcfffcfffdull) return;  // asm constant would be wrong
+  Fp pm1;  // p - 1 (a valid residue; Montgomery form irrelevant for check)
+  for (int i = 0; i < 6; i++) pm1.v[i] = P_LIMBS[i];
+  pm1.v[0] -= 1;
+  Fp cases[4] = {FP_ZERO, MONT_ONE, MONT_R2, pm1};
+  u64 seed = 0x9e3779b97f4a7c15ull;
+  Fp a = MONT_R2, b = MONT_ONE;
+  for (int iter = 0; iter < 64; iter++) {
+    if (iter < 16) {
+      a = cases[iter % 4];
+      b = cases[(iter / 4) % 4];
+    } else {  // xorshift walk keeps values "random" but reproducible
+      for (int i = 0; i < 6; i++) {
+        seed ^= seed << 13;
+        seed ^= seed >> 7;
+        seed ^= seed << 17;
+        a.v[i] ^= seed & 0x7fffffffffffffffull;
+      }
+      // reduce below p by clearing the top limb's high bits
+      a.v[5] &= 0x0fffffffffffffffull;
+    }
+    Fp zc, za;
+    fp_mul_c(zc, a, b);
+    lt_fp_mul_adx(za.v, a.v, b.v);
+    if (!fp_eq(zc, za)) return;
+    b = zc;  // feed results forward
+  }
+  HAVE_ADX = true;
+#endif
+}
+
 static struct Init {
   Init() {
     compute_pinv();
@@ -1825,6 +2399,39 @@ static struct Init {
     H_G1_BYTES = hex_to_bytes(H_G1_HEX);
     H_G2_BYTES = hex_to_bytes(H_G2_HEX);
 
+    // GLV constants: z^2, lambda = z^2 - 1, and Barrett MU = floor(2^384/r)
+    {
+      const u64 zabs = 0xd201000000010000ull;
+      u128 z2 = (u128)zabs * zabs;
+      Z2_LIMBS[0] = (u64)z2;
+      Z2_LIMBS[1] = (u64)(z2 >> 64);
+      u128 lam = z2 - 1;
+      LAM_LIMBS[0] = (u64)lam;
+      LAM_LIMBS[1] = (u64)(lam >> 64);
+      // binary long division of 2^384 by r: 385 shift-subtract steps
+      u64 rem[5] = {0, 0, 0, 0, 0}, q[7] = {0, 0, 0, 0, 0, 0, 0};
+      u64 rw[5] = {R_LIMBS[0], R_LIMBS[1], R_LIMBS[2], R_LIMBS[3], 0};
+      for (int bit = 384; bit >= 0; bit--) {
+        // rem = rem*2 + numerator_bit (numerator = 2^384)
+        u64 carry = bit == 384 ? 1 : 0;
+        for (int i = 0; i < 5; i++) {
+          u64 hi = rem[i] >> 63;
+          rem[i] = (rem[i] << 1) | carry;
+          carry = hi;
+        }
+        u64 t[5];
+        memcpy(t, rem, 40);
+        if (!limbs_sub(t, rw, 5)) {
+          memcpy(rem, t, 40);
+          q[bit / 64] |= 1ull << (bit % 64);
+        }
+      }
+      MU384[0] = q[0];
+      MU384[1] = q[1];
+      MU384[2] = q[2];  // MU < 2^130: limbs 3+ are zero
+    }
+
+    adx_selfcheck();
     cyc_selfcheck();
   }
 } _init;
@@ -1874,12 +2481,29 @@ int lt_g2_add(const uint8_t a[192], const uint8_t b[192], uint8_t out[192]) {
   return 0;
 }
 
-// Pippenger MSM over G1. pts: n*96 bytes, scalars: n*32 bytes BE.
+// MSM over G1. pts: n*96 bytes, scalars: n*32 bytes BE.
+// Small/medium n (every consensus shape: Lagrange combines at t+1, era
+// aggregates at N) takes the Straus/GLV path; huge n falls back to
+// Pippenger, whose shared buckets only win once n outgrows the GLV
+// window tables.
+//
+// CONTRACT: points must be members of the prime-order subgroup. The GLV
+// path reduces scalars mod r and uses the phi endomorphism, both of which
+// are only multiplication-compatible on the subgroup — an on-curve point
+// outside it gets an n-DEPENDENT answer (Straus vs Pippenger disagree).
+// Every production caller enforces this at wire-parse time
+// (native_backend.py routes deserialization through lt_g1_check == 2).
 int lt_g1_msm(const uint8_t *pts, const uint8_t *scalars, size_t n,
               uint8_t out[96]) {
   std::vector<G1> points(n);
   for (size_t i = 0; i < n; i++)
     if (!g1_from_bytes(points[i], pts + i * 96)) return 1;
+  if (n >= 1 && n <= 256) {
+    G1 total;
+    g1_msm_straus(total, points.data(), scalars, n);
+    g1_to_bytes(out, total);
+    return 0;
+  }
   const int c = n < 32 ? 4 : (n < 512 ? 8 : 12);
   const int nbuckets = (1 << c) - 1;
   const int nwindows = (256 + c - 1) / c;
@@ -1949,13 +2573,13 @@ int lt_g2_msm(const uint8_t *pts, const uint8_t *scalars, size_t n,
 // Prod e(Pi, Qi) == 1?  returns 1 yes, 0 no, -1 bad encoding.
 int lt_pairing_check(const uint8_t *g1s, const uint8_t *g2s, size_t n) {
   std::vector<MLState> states(n);
+  std::vector<G1> ps(n);
+  std::vector<G2> qs(n);
   for (size_t i = 0; i < n; i++) {
-    G1 p;
-    G2 q;
-    if (!g1_from_bytes(p, g1s + i * 96)) return -1;
-    if (!g2_from_bytes(q, g2s + i * 192)) return -1;
-    ml_init(states[i], p, q);
+    if (!g1_from_bytes(ps[i], g1s + i * 96)) return -1;
+    if (!g2_from_bytes(qs[i], g2s + i * 192)) return -1;
   }
+  ml_init_batch(states.data(), ps.data(), qs.data(), n);
   Fp12 f;
   miller_loop_multi(f, states.data(), n);
   Fp12 e;
@@ -1979,16 +2603,16 @@ int lt_pairing_check_mt(const uint8_t *g1s, const uint8_t *g2s, size_t n,
     size_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
     ts.emplace_back([&, t, lo, hi]() {
       std::vector<MLState> states(hi - lo);
+      std::vector<G1> ps(hi - lo);
+      std::vector<G2> qs(hi - lo);
       for (size_t i = lo; i < hi; i++) {
-        G1 p;
-        G2 q;
-        if (!g1_from_bytes(p, g1s + i * 96) ||
-            !g2_from_bytes(q, g2s + i * 192)) {
+        if (!g1_from_bytes(ps[i - lo], g1s + i * 96) ||
+            !g2_from_bytes(qs[i - lo], g2s + i * 192)) {
           bad[t] = 1;
           return;
         }
-        ml_init(states[i - lo], p, q);
       }
+      ml_init_batch(states.data(), ps.data(), qs.data(), hi - lo);
       Fp12 f;
       miller_loop_multi(f, states.data(), hi - lo);
       partial[t] = f;
