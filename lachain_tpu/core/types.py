@@ -66,6 +66,12 @@ class Transaction:
         return keccak256(self.encode() + write_u64(chain_id))
 
 
+# (signing_hash, signature) -> recovered address; _MISS marks a signature
+# that failed recovery so invalid txs don't retry the recover either
+_MISS = object()
+_SENDER_MEMO: dict = {}
+
+
 @dataclass(frozen=True)
 class SignedTransaction:
     tx: Transaction
@@ -86,15 +92,27 @@ class SignedTransaction:
         return keccak256(self.encode())
 
     def sender(self, chain_id: int) -> Optional[bytes]:
-        """Recovered 20-byte sender address, or None if invalid. Cached:
-        ordering, execution and the pool all ask repeatedly, and ECDSA
-        recovery dominates otherwise (reference caches the recovery in
-        TransactionManager's verify cache, TransactionManager.cs:141-171)."""
+        """Recovered 20-byte sender address, or None if invalid. Cached
+        per-object AND process-wide: ordering, execution and the pool all
+        ask repeatedly, and in-process multi-validator harnesses decode
+        the same wire tx into per-validator objects — without the shared
+        memo each validator pays the ECDSA recovery again (reference
+        caches recoveries in TransactionManager's verify cache,
+        TransactionManager.cs:141-171)."""
         cached = self.__dict__.get("_sender_cache")
         if cached is not None and cached[0] == chain_id:
             return cached[1]
-        pub = ecdsa.recover_hash(self.tx.signing_hash(chain_id), self.signature)
-        addr = None if pub is None else ecdsa.address_from_public_key(pub)
+        h = self.tx.signing_hash(chain_id)
+        key = (h, self.signature)
+        addr = _SENDER_MEMO.get(key)
+        if addr is _MISS:
+            addr = None
+        elif addr is None:
+            pub = ecdsa.recover_hash(h, self.signature)
+            addr = None if pub is None else ecdsa.address_from_public_key(pub)
+            if len(_SENDER_MEMO) > 65536:
+                _SENDER_MEMO.clear()
+            _SENDER_MEMO[key] = addr if addr is not None else _MISS
         object.__setattr__(self, "_sender_cache", (chain_id, addr))
         return addr
 

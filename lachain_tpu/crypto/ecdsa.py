@@ -80,10 +80,36 @@ def public_key_point(priv: bytes) -> Tuple[int, int]:
     return _mul(G, int.from_bytes(priv, "big"))
 
 
+# keccak(priv) -> compressed pubkey; nodes sign with a handful of
+# long-lived keys and the pure-Python ladder costs ~10 ms per derivation.
+# Keyed by a HASH of the private key so the cache never pins secret bytes
+# in process memory beyond the caller's own copy.
+_pub_cache: dict = {}
+
+
 def public_key_bytes(priv: bytes) -> bytes:
     """Compressed SEC1 encoding (33 bytes)."""
-    x, y = public_key_point(priv)
-    return bytes([0x02 | (y & 1)]) + x.to_bytes(32, "big")
+    from .hashes import keccak256
+
+    ck = keccak256(priv)
+    cached = _pub_cache.get(ck)
+    if cached is not None:
+        return cached
+    pub = None
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes as _ct
+
+        out = (_ct.c_ubyte * 33)()
+        if lib.lt_ec_pubkey(priv, out) == 0:
+            pub = bytes(out)
+    if pub is None:
+        x, y = public_key_point(priv)
+        pub = bytes([0x02 | (y & 1)]) + x.to_bytes(32, "big")
+    if len(_pub_cache) > 4096:
+        _pub_cache.clear()
+    _pub_cache[ck] = pub
+    return pub
 
 
 def decompress_public_key(pub: bytes) -> Tuple[int, int]:

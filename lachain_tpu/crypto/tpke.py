@@ -284,6 +284,14 @@ class TpkePublicKey:
         return decrypt_with_combined(share, y_r)
 
 
+# ciphertext-validity memo: (u, v, w) -> bool. A pairing equation's truth
+# is a pure function of the ciphertext, so re-verifications — protocol
+# retries on a node, N validators sharing a process in the simulator —
+# skip the Millers entirely. Verdicts (both ways) are cached; the RLC
+# weights only affect isolation, not the per-ciphertext verdict.
+_CT_VALID_MEMO: dict = {}
+
+
 def batch_verify_ciphertexts(
     shares: Sequence["EncryptedShare"], backend=None, rng=secrets
 ) -> List[bool]:
@@ -297,11 +305,17 @@ def batch_verify_ciphertexts(
         backend = get_backend()
     if not shares:
         return []
-    hs = [_hash_uv_to_g2(s.u, s.v) for s in shares]
+    keys = [(s.u, s.v, s.w) for s in shares]
+    out: List = [_CT_VALID_MEMO.get(k) for k in keys]
+    todo = [i for i, v in enumerate(out) if v is None]
+    if not todo:
+        return out
+    hs = {i: _hash_uv_to_g2(shares[i].u, shares[i].v) for i in todo}
 
     def group_ok(idx):
         pairs = []
-        for i in idx:
+        for t in idx:
+            i = todo[t]
             r_s = rng.randbelow((1 << 128) - 1) + 1
             pairs.append((backend.g1_mul(bls.G1_GEN, r_s), shares[i].w))
             pairs.append(
@@ -309,7 +323,13 @@ def batch_verify_ciphertexts(
             )
         return backend.pairing_check(pairs)
 
-    return batch_bisect_verify(group_ok, len(shares))
+    verdicts = batch_bisect_verify(group_ok, len(todo))
+    if len(_CT_VALID_MEMO) > 65536:
+        _CT_VALID_MEMO.clear()
+    for t, ok in zip(todo, verdicts):
+        out[t] = ok
+        _CT_VALID_MEMO[keys[t]] = ok
+    return out
 
 
 def peek_decrypted_share_ids(data: bytes):
@@ -391,16 +411,48 @@ def era_verify_combine_host(
                 y_cache[ykey] = y_pt
         entries.append((c_pt, y_pt, job.h, job.w))
 
-    def group_ok(idx):
-        pairs = []
-        for t in idx:
-            c_pt, y_pt, h, w = entries[t]
-            r_s = rng.randbelow((1 << 128) - 1) + 1
-            pairs.append((backend.g1_mul(c_pt, r_s), h))
-            pairs.append((bls.g1_neg(backend.g1_mul(y_pt, r_s)), w))
-        return backend.pairing_check(pairs)
+    # Cross-validator fold: in an era tick every validator holds a slot for
+    # the SAME proposal ciphertext, so slots sharing (h, w) fold into ONE
+    # pair of Millers — e(sum_s r_s C_s, h) * e(-sum_s r_s Y_s, w) — via a
+    # per-group MSM over the per-slot random weights. At N validators this
+    # cuts the grand product from 2*S to 2*(#ciphertexts) Millers. The
+    # per-slot weights r_s stay random for EVERY slot (groups inherit their
+    # randomness): a fixed error in one group could otherwise cancel a
+    # fixed error in another deterministically.
+    groups: dict = {}
+    for t, e in enumerate(entries):
+        groups.setdefault((e[2], e[3]), []).append(t)
+    glist = list(groups.values())
 
-    oks = batch_bisect_verify(group_ok, len(entries))
+    def fold_pairs(idx_list):
+        pairs = []
+        for t_list in idx_list:
+            h, w = entries[t_list[0]][2], entries[t_list[0]][3]
+            weights = [rng.randbelow((1 << 128) - 1) + 1 for _ in t_list]
+            c_agg = backend.g1_msm([entries[t][0] for t in t_list], weights)
+            y_agg = backend.g1_msm([entries[t][1] for t in t_list], weights)
+            pairs.append((c_agg, h))
+            pairs.append((bls.g1_neg(y_agg), w))
+        return pairs
+
+    def group_ok(gidx):
+        return backend.pairing_check(fold_pairs([glist[g] for g in gidx]))
+
+    g_oks = batch_bisect_verify(group_ok, len(glist))
+    oks = [True] * len(entries)
+    for gi, gok in enumerate(g_oks):
+        if gok:
+            continue
+        # a failing ciphertext group bisects again over its own slots
+        idxs = glist[gi]
+
+        def slot_ok(sub):
+            return backend.pairing_check(
+                fold_pairs([[idxs[s]] for s in sub])
+            )
+
+        for si, sok in zip(idxs, batch_bisect_verify(slot_ok, len(idxs))):
+            oks[si] = sok
     return [(ok, entries[t][0] if ok else None) for t, ok in enumerate(oks)]
 
 
