@@ -1,0 +1,170 @@
+"""Contract-execution (WASM VM) benchmark — the repo counterpart of the
+reference's VirtualMachineBenchmark
+(/root/reference/src/Lachain.Benchmark/VirtualMachineBenchmark.cs): run a
+compute-heavy loop through BOTH engine tiers, and full contract-call
+transactions (storage-writing counter, the reference benchmark's shape)
+through the execution path. Prints ONE JSON line.
+
+Usage: python benchmarks/bench_vm.py [--iters 200000] [--calls 200]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _loop_module() -> bytes:
+    """sum 1..n — the branch/arith inner-loop shape VM benchmarks use."""
+    from lachain_tpu.vm.builder import I32, ModuleBuilder, Op
+
+    b = ModuleBuilder()
+    b.add_function(
+        [I32], [I32], [I32],
+        [
+            Op.block(),
+            Op.loop(),
+            Op.local_get(0), Op.i32_eqz, Op.br_if(1),
+            Op.local_get(1), Op.local_get(0), Op.i32_add, Op.local_set(1),
+            Op.local_get(0), Op.i32_const(1), Op.i32_sub, Op.local_set(0),
+            Op.br(0),
+            Op.end,
+            Op.end,
+            Op.local_get(1),
+        ],
+        export="run",
+    )
+    return b.build()
+
+
+def _counter_contract() -> bytes:
+    """Storage-writing counter (same module tests/test_vm.py uses)."""
+    from lachain_tpu.vm import abi
+    from lachain_tpu.vm.builder import I32, ModuleBuilder, Op
+
+    sel_inc = int.from_bytes(abi.method_selector("inc()"), "little")
+    b = ModuleBuilder()
+    copy_call = b.add_import("env", "copy_call_value", [I32, I32, I32], [])
+    load_st = b.add_import("env", "load_storage", [I32, I32], [])
+    save_st = b.add_import("env", "save_storage", [I32, I32], [])
+    set_ret = b.add_import("env", "set_return", [I32, I32], [])
+    body = [
+        Op.i32_const(0), Op.i32_const(4), Op.i32_const(0), Op.call(copy_call),
+        Op.i32_const(64), Op.i32_const(96), Op.call(load_st),
+        Op.i32_const(0), Op.i32_load(), Op.i32_const(sel_inc), Op.i32_eq,
+        Op.if_(),
+        Op.i32_const(96),
+        Op.i32_const(96), Op.i64_load(), Op.i64_const(1), Op.i64_add,
+        Op.i64_store(),
+        Op.i32_const(64), Op.i32_const(96), Op.call(save_st),
+        Op.i32_const(96), Op.i32_const(8), Op.call(set_ret),
+        Op.return_,
+        Op.end,
+        Op.unreachable,
+    ]
+    b.add_memory(1)
+    b.add_function([], [], [], body, export="start")
+    return b.build()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200_000)
+    ap.add_argument("--calls", type=int, default=200)
+    args = ap.parse_args()
+
+    from lachain_tpu.vm.interpreter import Instance
+    from lachain_tpu.vm.wasm import decode_module
+
+    code = _loop_module()
+    # ~7 ops per loop iteration in the body above
+    ops = args.iters * 7
+
+    # interpreter tier (LACHAIN_TPU_WASM=interp forces it)
+    os.environ["LACHAIN_TPU_WASM"] = "interp"
+    inst = Instance(decode_module(code))
+    t0 = time.perf_counter()
+    expected = inst.invoke("run", [args.iters])
+    interp_s = time.perf_counter() - t0
+    del os.environ["LACHAIN_TPU_WASM"]
+
+    # translated tier (the default; translation happens on first call)
+    inst2 = Instance(decode_module(code))
+    inst2.invoke("run", [16])  # pay translation outside the timer
+    t0 = time.perf_counter()
+    got = inst2.invoke("run", [args.iters])
+    trans_s = time.perf_counter() - t0
+    assert got == expected, (got, expected)
+
+    # full path: contract-call transactions through the executer
+    from lachain_tpu.core import execution, system_contracts
+    from lachain_tpu.core.types import Transaction, sign_transaction
+    from lachain_tpu.crypto import ecdsa
+    from lachain_tpu.storage.kv import MemoryKV
+    from lachain_tpu.storage.state import StateManager
+    from lachain_tpu.utils.serialization import write_bytes
+    from lachain_tpu.vm import abi
+
+    chain = 414
+    priv = ecdsa.generate_private_key(Rng(5))
+    addr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(priv))
+    state = StateManager(MemoryKV())
+    snap = state.new_snapshot()
+    execution.set_balance(snap, addr, 10**24)
+    ex = system_contracts.make_executer(chain)
+
+    deploy = sign_transaction(
+        Transaction(
+            to=system_contracts.DEPLOY_ADDRESS,
+            value=0, nonce=0, gas_price=1, gas_limit=10**12,
+            invocation=system_contracts.SEL_DEPLOY
+            + write_bytes(_counter_contract()),
+        ),
+        priv, chain,
+    )
+    r = ex.execute(snap, deploy, 1, 0)
+    assert r.ok, "deploy failed"
+    caddr = r.receipt.return_data
+
+    sel_inc = abi.method_selector("inc()")
+    txs = [
+        sign_transaction(
+            Transaction(
+                to=caddr, value=0, nonce=1 + i, gas_price=1,
+                gas_limit=10**12, invocation=sel_inc,
+            ),
+            priv, chain,
+        )
+        for i in range(args.calls)
+    ]
+    t0 = time.perf_counter()
+    okc = sum(1 for i, tx in enumerate(txs) if ex.execute(snap, tx, 2, i).ok)
+    calls_s = time.perf_counter() - t0
+    assert okc == args.calls, f"only {okc}/{args.calls} calls succeeded"
+
+    print(json.dumps({
+        "metric": "vm_translated_ops_per_s",
+        "value": round(ops / trans_s),
+        "unit": f"wasm ops/s, translated tier ({args.iters}-iter loop)",
+        "interp_ops_per_s": round(ops / interp_s),
+        "speedup_vs_interp": round(interp_s / trans_s, 1),
+        "contract_calls_per_s": round(args.calls / calls_s, 1),
+        "note": "reference driver: Lachain.Benchmark/VirtualMachineBenchmark.cs",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,16 @@ class SignedTransaction:
     signature: bytes  # 65-byte recoverable ECDSA
 
     def encode(self) -> bytes:
-        return write_bytes(self.tx.encode()) + write_bytes(self.signature)
+        # immutable value object: ordering, pooling, block assembly and
+        # hashing all re-encode the same tx many times per era — memoize
+        # (the reference's proto objects keep their serialized form too)
+        cached = self.__dict__.get("_enc_cache")
+        if cached is None:
+            cached = write_bytes(self.tx.encode()) + write_bytes(
+                self.signature
+            )
+            object.__setattr__(self, "_enc_cache", cached)
+        return cached
 
     @classmethod
     def decode(cls, data: bytes) -> "SignedTransaction":
@@ -86,10 +95,18 @@ class SignedTransaction:
         tx = Transaction.decode(r.bytes_())
         sig = r.bytes_()
         r.assert_eof()
-        return cls(tx, sig)
+        out = cls(tx, sig)
+        # assert_eof proved `data` IS the canonical encoding — seed the
+        # memo so wire-decoded txs never pay the re-encode either
+        object.__setattr__(out, "_enc_cache", data)
+        return out
 
     def hash(self) -> bytes:
-        return keccak256(self.encode())
+        cached = self.__dict__.get("_hash_cache")
+        if cached is None:
+            cached = keccak256(self.encode())
+            object.__setattr__(self, "_hash_cache", cached)
+        return cached
 
     def sender(self, chain_id: int) -> Optional[bytes]:
         """Recovered 20-byte sender address, or None if invalid. Cached

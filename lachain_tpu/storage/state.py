@@ -142,9 +142,13 @@ class StateManager:
 
     def commit(self, height: int, roots: StateRoots) -> None:
         """Persist roots as the canonical state for `height` (checkpoint —
-        every block is a checkpoint, SURVEY.md §5)."""
+        every block is a checkpoint, SURVEY.md §5). The trie's buffered
+        node writes land in the SAME atomic fsynced batch as the root
+        index, so a crash can never leave a root without its nodes."""
+        nodes = self.trie.peek_pending()
         self._kv.write_batch(
-            [
+            nodes
+            + [
                 (
                     prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(height)),
                     roots.encode(),
@@ -152,6 +156,9 @@ class StateManager:
                 (prefixed(EntryPrefix.BLOCK_HEIGHT), write_u64(height)),
             ]
         )
+        # only after the batch is durable: a failed write_batch must keep
+        # the buffer (it holds the only copy of the nodes)
+        self.trie.confirm_pending(nodes)
         self._committed = roots
 
     def roots_at(self, height: int) -> Optional[StateRoots]:
