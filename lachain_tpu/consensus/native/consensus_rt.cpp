@@ -282,6 +282,12 @@ static bool rs_decode(const std::vector<std::string>& shards, int k,
     if (!shards[i].empty()) have_idx.push_back(i);
   if ((int)have_idx.size() < k) return false;
   size_t size = shards[have_idx[0]].size();
+  // adversarial-input guard (mirrors rs.py::decode): a malicious proposer
+  // can commit a Merkle root over DIFFERENT-SIZED shards, each carrying a
+  // valid branch — without this check the XOR loop below reads past the
+  // end of the shorter shard's buffer
+  for (int i = 1; i < k; i++)
+    if (shards[have_idx[i]].size() != size) return false;
   // Vandermonde rows [x^0 .. x^{k-1}] at x = idx+1
   std::vector<uint8_t> mat((size_t)k * k);
   for (int r = 0; r < k; r++) {
@@ -1229,5 +1235,22 @@ uint64_t rt_opaque_pending(void* h, int kind) {
 size_t rt_queue_len(void* h) { return static_cast<Engine*>(h)->q.size(); }
 
 uint64_t rt_delivered(void* h) { return static_cast<Engine*>(h)->delivered; }
+
+// test/fuzz hook: drive rs_decode with arbitrary shard vectors (lens[i]==0
+// marks a missing shard). Returns 1 + writes out/out_len on success, 0 on
+// clean decode failure. out must hold k * max(lens) bytes.
+int rt_test_rs_decode(const uint8_t* const* shard_ptrs, const size_t* lens,
+                      int n, int k, uint8_t* out, size_t* out_len) {
+  gf_init();  // harness may call this before any Engine exists
+  std::vector<std::string> shards(n);
+  for (int i = 0; i < n; i++)
+    if (lens[i])
+      shards[i].assign(reinterpret_cast<const char*>(shard_ptrs[i]), lens[i]);
+  std::string payload;
+  if (!rs_decode(shards, k, payload)) return 0;
+  std::memcpy(out, payload.data(), payload.size());
+  *out_len = payload.size();
+  return 1;
+}
 
 }  // extern "C"

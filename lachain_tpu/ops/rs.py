@@ -105,6 +105,11 @@ def decode(shards: Sequence[Optional[bytes]], k: int) -> Optional[bytes]:
     have = have[:k]
     xs = [_eval_points(n)[i] for i, _ in have]
     size = len(have[0][1])
+    # adversarial-input guard: a malicious proposer can commit a Merkle
+    # root over DIFFERENT-SIZED shards (each with a valid branch); mixed
+    # sizes must be a clean decode failure, not a crash (np.stack raises)
+    if any(len(s) != size for _, s in have):
+        return None
     mat = np.zeros((k, k), dtype=np.uint8)  # Vandermonde rows [x^0 .. x^{k-1}]
     for r, x in enumerate(xs):
         v = 1

@@ -1,0 +1,27 @@
+#!/bin/bash
+# ASan+UBSan gate for the native engines (VERDICT r4 #6 / SURVEY §5).
+# Builds the crypto + consensus TUs with sanitizers and runs:
+#   1. the MSM/pairing differential harness (benchmarks/native/check_msm)
+#   2. a time-boxed decoder fuzzer (structured + random mutations)
+#   3. a time-boxed consensus-engine fuzzer (hostile shards, live engines)
+# Any sanitizer report aborts with a non-zero exit (no recover).
+set -euo pipefail
+cd "$(dirname "$0")"
+FUZZ_SECONDS="${FUZZ_SECONDS:-20}"
+SAN="-fsanitize=address,undefined -fno-sanitize-recover=all -fno-omit-frame-pointer"
+CXXFLAGS="-O1 -g -march=native -std=c++17 -pthread $SAN"
+BUILD=./.sanitize-build
+mkdir -p "$BUILD"
+
+echo "== building sanitized harnesses =="
+g++ $CXXFLAGS -o "$BUILD/check_msm" ../../benchmarks/native/check_msm.cpp
+g++ $CXXFLAGS -o "$BUILD/fuzz_decoders" fuzz_decoders.cpp
+g++ $CXXFLAGS -o "$BUILD/fuzz_consensus" fuzz_consensus.cpp
+
+echo "== differential (sanitized) =="
+"$BUILD/check_msm"
+echo "== fuzz decoders (${FUZZ_SECONDS}s) =="
+"$BUILD/fuzz_decoders" "$FUZZ_SECONDS"
+echo "== fuzz consensus (${FUZZ_SECONDS}s) =="
+"$BUILD/fuzz_consensus" "$FUZZ_SECONDS"
+echo "SANITIZE GREEN"

@@ -170,3 +170,53 @@ def test_native_era_advance_and_postponed():
     # era never regresses
     net.net.routers[0].advance_era(1)
     assert net.net.routers[0].era == 2
+
+
+def test_rs_decode_mixed_size_shards_rejected():
+    """Adversarial mixed-size shards (a proposer can Merkle-commit to
+    different-sized shards, each with a valid branch) must be a clean
+    decode failure on BOTH engines — the Python path used to crash in
+    np.stack and the C++ path read past the shorter shard's buffer
+    (caught by tests/native/sanitize.sh under ASan)."""
+    import ctypes
+
+    from lachain_tpu.consensus.native_rt import load_rt
+    from lachain_tpu.ops import rs
+
+    # python engine: clean None
+    payload = b"mixed-size-attack-payload"
+    shards = list(rs.encode(payload, 2, 4))
+    shards_bad = [shards[0] + b"\x00" * 7, shards[1], None, None]
+    assert rs.decode(shards_bad, 2) is None
+    # sanity: well-formed still decodes
+    assert rs.decode([shards[0], shards[1], None, None], 2) == payload
+
+    # native engine: same verdicts through the test hook
+    lib = load_rt()
+    lib.rt_test_rs_decode.restype = ctypes.c_int
+    n = 4
+    arr_t = ctypes.POINTER(ctypes.c_ubyte) * n
+    len_t = ctypes.c_size_t * n
+
+    def native_decode(sh):
+        bufs = [
+            (ctypes.c_ubyte * len(s)).from_buffer_copy(s) if s else None
+            for s in sh
+        ]
+        ptrs = arr_t(*[
+            ctypes.cast(b, ctypes.POINTER(ctypes.c_ubyte))
+            if b is not None
+            else ctypes.POINTER(ctypes.c_ubyte)()
+            for b in bufs
+        ])
+        lens = len_t(*[len(s) if s else 0 for s in sh])
+        cap = 2 * max((len(s) for s in sh if s), default=1) + 64
+        out = (ctypes.c_ubyte * cap)()
+        out_len = ctypes.c_size_t(0)
+        ok = lib.rt_test_rs_decode(
+            ptrs, lens, n, 2, out, ctypes.byref(out_len)
+        )
+        return bytes(out[: out_len.value]) if ok else None
+
+    assert native_decode(shards_bad) is None
+    assert native_decode([shards[0], shards[1], None, None]) == payload
