@@ -206,7 +206,10 @@ async def _run_node(cfg, args) -> None:
     rpc = None
     if cfg.rpc.enabled:
         rpc = await node.start_rpc(
-            cfg.rpc.host, cfg.rpc.port, api_key=cfg.rpc.api_key
+            cfg.rpc.host,
+            cfg.rpc.port,
+            api_key=cfg.rpc.api_key,
+            auth_pubkey=cfg.rpc.auth_pubkey,
         )
         print(f"rpc: http://{cfg.rpc.host}:{rpc.port}", flush=True)
     if args.stake:

@@ -155,6 +155,11 @@ class RpcSection:
     host: str = "127.0.0.1"
     port: int = 7071
     api_key: Optional[str] = None
+    # compressed secp256k1 pubkey hex whose signature unlocks the private
+    # RPC methods (reference config "apiKey" doubles as this; kept separate
+    # here so the static header key and the signing identity can rotate
+    # independently)
+    auth_pubkey: Optional[str] = None
 
 
 @dataclass
@@ -227,6 +232,7 @@ class NodeConfig:
                 host=rpc.get("host", "127.0.0.1"),
                 port=int(rpc.get("port", 7071)),
                 api_key=rpc.get("apiKey"),
+                auth_pubkey=rpc.get("authPubkey"),
             ),
             blockchain=BlockchainSection(
                 target_txs_per_block=int(bc.get("targetTxsPerBlock", 1000)),

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ..crypto.hashes import keccak256
 from ..network import wire
 from ..storage.kv import EntryPrefix, prefixed
+from ..utils import metrics
 from ..storage.state import StateRoots
 from ..storage.trie import EMPTY_ROOT, InternalNode
 from .synchronizer import verify_block_multisig
@@ -217,6 +218,8 @@ class FastSynchronizer:
                 puts.append((prefixed(EntryPrefix.TRIE_NODE, h), got[h]))
             kv.write_batch(puts)
             downloaded += len(want)
+            # progress counter served by la_getDownloadedNodesTillNow
+            metrics.inc("fastsync_nodes_downloaded", len(want))
             for h in want:
                 rest.extend(self._children_of(h, seen))
             pending = rest

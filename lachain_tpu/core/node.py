@@ -246,13 +246,18 @@ class Node:
         host: str = "127.0.0.1",
         port: int = 0,
         api_key: Optional[str] = None,
+        auth_pubkey: Optional[str] = None,
     ):
         """Expose the Web3-shaped JSON-RPC surface (reference
         RpcManager.Start, RPC/RpcManager.cs:1-129). Returns the server
-        (its .port reflects the bound port)."""
+        (its .port reflects the bound port). `auth_pubkey` (compressed
+        secp256k1 pubkey hex) unlocks the PRIVATE_METHODS family via
+        timestamp+signature auth; when None they are refused."""
         from ..rpc import JsonRpcServer, RpcService
 
-        server = JsonRpcServer(host, port, api_key=api_key)
+        server = JsonRpcServer(
+            host, port, api_key=api_key, auth_pubkey=auth_pubkey
+        )
         server.register_all(RpcService(self).methods())
         await server.start()
         self._rpc_server = server

@@ -219,5 +219,31 @@ class TransactionPool:
         with self._lock:
             return set(self._txs)
 
+    def clear(self) -> None:
+        """Drop every pooled tx, memory AND persisted entries (reference
+        clearInMemoryPool + repository delete, TransactionPool.cs)."""
+        with self._lock:
+            for h in list(self._txs):
+                self._evict(h)
+
+    def persisted_hashes(self) -> List[bytes]:
+        """Hashes of txs currently saved in the crash-restore repository."""
+        plen = len(prefixed(EntryPrefix.POOL_TX))
+        return [
+            key[plen:]
+            for key, _ in self._kv.scan_prefix(prefixed(EntryPrefix.POOL_TX))
+        ]
+
+    def clear_persisted(self) -> int:
+        """Wipe the crash-restore repository WITHOUT touching the live pool
+        (reference deleteTransactionPoolRepository)."""
+        n = 0
+        for key, _ in list(
+            self._kv.scan_prefix(prefixed(EntryPrefix.POOL_TX))
+        ):
+            self._kv.delete(key)
+            n += 1
+        return n
+
     def get(self, h: bytes) -> Optional[SignedTransaction]:
         return self._txs.get(h)

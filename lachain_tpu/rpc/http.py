@@ -28,6 +28,91 @@ class JsonRpcError(Exception):
         self.message = message
 
 
+# Sensitive methods gated behind timestamp+signature auth (the reference's
+# HttpService._privateMethods list, HttpService.cs:40-62): anything that
+# spends from the node wallet, mutates the pool, changes validator state,
+# or serves bulk state-dump queries.
+PRIVATE_METHODS = frozenset({
+    "validator_start",
+    "validator_start_with_stake",
+    "validator_stop",
+    "fe_sendTransaction",
+    "deleteTransactionPoolRepository",
+    "clearInMemoryPool",
+    "eth_sendTransaction",
+    "eth_signTransaction",
+    "fe_unlock",
+    "fe_changePassword",
+    "sendContract",
+    "deployContract",
+    "la_getStateByNumber",
+    "la_getBlockRawByNumberBatch",
+    "la_getAllTriesHash",
+    "la_getNodeByHashBatch",
+    "la_getChildrenByHashBatch",
+    "la_getChildrenByVersionBatch",
+    "la_sendRawTransactionBatch",
+    "la_sendRawTransactionBatchParallel",
+})
+
+# signed timestamps are valid this long (reference: 30 minutes,
+# HttpService.cs:236-239; we additionally reject FUTURE timestamps beyond
+# the same bound so a stolen far-future signature cannot replay forever)
+AUTH_WINDOW_SECONDS = 30 * 60
+
+
+def serialize_params(args) -> str:
+    """Deterministic param serialization for the auth digest (mirrors the
+    reference's SerializeParams, HttpService.cs:190-225: JObject flattens
+    to key1value1key2value2... recursively; scalars stringify; the
+    reference passes arrays as null -> empty string, here arrays flatten
+    element-wise so positional params are covered by the signature too)."""
+    if args is None:
+        return ""
+    if isinstance(args, dict):
+        return "".join(
+            str(k) + serialize_params(v) for k, v in args.items()
+        )
+    if isinstance(args, (list, tuple)):
+        return "".join(serialize_params(v) for v in args)
+    if isinstance(args, bool):
+        return "True" if args else "False"  # C# ToString casing
+    return str(args)
+
+
+def check_private_auth(
+    auth_pubkey: Optional[str], method: str, params, signature: str,
+    timestamp: str,
+) -> bool:
+    """Reference HttpService._CheckAuth (cs:227-279): the caller signs
+    keccak(method + serialized_params + timestamp) with the operator key;
+    the recovered compressed pubkey must equal the configured one."""
+    import time
+
+    from ..crypto import ecdsa
+    from ..crypto.hashes import keccak256
+
+    if not auth_pubkey or not signature or not timestamp:
+        return False
+    try:
+        ts = int(timestamp.strip())
+    except ValueError:
+        return False
+    if abs(time.time() - ts) >= AUTH_WINDOW_SECONDS:
+        return False
+    msg = (method + serialize_params(params) + timestamp.strip()).encode()
+    try:
+        sig = bytes.fromhex(signature.removeprefix("0x"))
+        pub = ecdsa.recover_hash(keccak256(msg), sig)
+    except Exception:
+        return False
+    if pub is None:
+        return False
+    return hmac.compare_digest(
+        pub.hex(), auth_pubkey.removeprefix("0x").lower()
+    )
+
+
 class JsonRpcServer:
     """Dispatches JSON-RPC 2.0 requests to registered methods."""
 
@@ -37,10 +122,23 @@ class JsonRpcServer:
         port: int = 0,
         *,
         api_key: Optional[str] = None,
+        auth_pubkey: Optional[str] = None,
     ):
         self.host = host
         self.port = port
         self.api_key = api_key
+        # compressed secp256k1 pubkey hex: when set, PRIVATE_METHODS require
+        # a valid timestamp+signature pair (reference _CheckAuth). When
+        # unset, private methods stay usable ONLY over loopback (the local
+        # operator owns the box — console/devnet ergonomics); any
+        # non-loopback bind without a key refuses them outright, so an
+        # exposed node is never silently open.
+        self.auth_pubkey = auth_pubkey
+        self._privates_gated = auth_pubkey is not None or host not in (
+            "127.0.0.1",
+            "localhost",
+            "::1",
+        )
         self._methods: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -125,7 +223,7 @@ class JsonRpcServer:
                 if method.upper() != "POST":
                     await self._respond(writer, 405, b"POST only")
                     continue
-                payload = await self._process(body)
+                payload = await self._process(body, headers)
                 await self._respond(
                     writer, 200, payload, ctype="application/json"
                 )
@@ -157,21 +255,22 @@ class JsonRpcServer:
 
     # -- JSON-RPC semantics --------------------------------------------------
 
-    async def _process(self, body: bytes) -> bytes:
+    async def _process(self, body: bytes, headers=None) -> bytes:
         try:
             req = json.loads(body)
         except Exception:
             return json.dumps(
                 _err(None, -32700, "parse error")
             ).encode()
+        headers = headers or {}
         if isinstance(req, list):
-            out = [await self._one(r) for r in req]
+            out = [await self._one(r, headers) for r in req]
             out = [r for r in out if r is not None]
             return json.dumps(out).encode()
-        res = await self._one(req)
+        res = await self._one(req, headers)
         return json.dumps(res if res is not None else {}).encode()
 
-    async def _one(self, req) -> Optional[dict]:
+    async def _one(self, req, headers=None) -> Optional[dict]:
         if not isinstance(req, dict):
             return _err(None, -32600, "invalid request")
         rid = req.get("id")
@@ -180,6 +279,19 @@ class JsonRpcServer:
         fn = self._methods.get(method)
         if fn is None:
             return _err(rid, -32601, f"method {method!r} not found")
+        if method in PRIVATE_METHODS:
+            h = headers or {}
+            # a browser always attaches Origin to cross-site fetches; the
+            # loopback no-key exemption must never extend to them (CSRF:
+            # a web page can POST to 127.0.0.1 even though it cannot read
+            # the response)
+            browser_origin = "origin" in h
+            if self._privates_gated or browser_origin:
+                if not check_private_auth(
+                    self.auth_pubkey, method, params,
+                    h.get("signature", ""), h.get("timestamp", ""),
+                ):
+                    return _err(rid, -32000, "unauthorized private method")
         try:
             if isinstance(params, dict):
                 result = fn(**params)

@@ -75,6 +75,11 @@ def set_gauge(name: str, value: float) -> None:
         _gauges[name] = value
 
 
+def counter_value(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0.0)
+
+
 def observe(name: str, seconds: float) -> None:
     with _lock:
         cnt, total = _timers.get(name, (0, 0.0))
