@@ -295,7 +295,16 @@ class PallasEraPipeline:
         lag1 = pg1.digits_col([h[0] for h in halves], pg1.W128)
         lag2 = pg1.digits_col([h[1] for h in halves], pg1.W128)
         buf = jnp.asarray(pg1.era_pack_inputs(u_np, rlc16, lag1, lag2))
-        fused = pg1.era_kernel_packed_jit(buf, y_dev, k_pad, s * k_pad)
+        from ..crypto import kernel_cache
+
+        fused = kernel_cache.call(
+            pg1.era_kernel_packed_jit,
+            "pg1_era_packed",
+            buf,
+            y_dev,
+            k=k_pad,
+            n=s * k_pad,
+        )
         fused = np.asarray(fused)  # ONE device->host transfer
         pts, flags = fused[:132], fused[132] != 0
         cols = pg1.g1_unpack(pts, flags)  # 4S points: u_agg|y_agg|c1|c2
@@ -352,12 +361,16 @@ class TsPallasPipeline:
         ]
         rlc_flat = [c for row in rlc for c in row + [0] * pad]
         lag_flat = [c for _, lag in coins for c in lag + [0] * pad]
-        fused = pg2.ts_era_kernel_jit(
+        from ..crypto import kernel_cache
+
+        fused = kernel_cache.call(
+            pg2.ts_era_kernel_jit,
+            "pg2_ts_era",
             jnp.asarray(pg2.g2_pack(sig_flat)),
             self._y_cache.get(y_points, s, k_pad),
             jnp.asarray(pg1.digits_col(rlc_flat, pg2.W64)),
             jnp.asarray(pg1.digits_col(lag_flat, pg2.W256)),
-            k_pad,
+            k=k_pad,
         )
         fused = np.asarray(fused)  # ONE device->host transfer
         pr = pg2.POINT2_ROWS

@@ -11,6 +11,7 @@ import asyncio
 import hmac
 import json
 import logging
+import threading
 from typing import Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -62,22 +63,23 @@ AUTH_WINDOW_SECONDS = 30 * 60
 
 
 def serialize_params(args) -> str:
-    """Deterministic param serialization for the auth digest (mirrors the
-    reference's SerializeParams, HttpService.cs:190-225: JObject flattens
-    to key1value1key2value2... recursively; scalars stringify; the
-    reference passes arrays as null -> empty string, here arrays flatten
-    element-wise so positional params are covered by the signature too)."""
-    if args is None:
-        return ""
-    if isinstance(args, dict):
-        return "".join(
-            str(k) + serialize_params(v) for k, v in args.items()
-        )
-    if isinstance(args, (list, tuple)):
-        return "".join(serialize_params(v) for v in args)
-    if isinstance(args, bool):
-        return "True" if args else "False"  # C# ToString casing
-    return str(args)
+    """Canonical JSON of the params for the auth digest. DESIGN DIVERGENCE
+    from the reference's SerializeParams (HttpService.cs:190-225), which
+    concatenates keys/values with NO delimiters: there, distinct param
+    splits collide to the same string ('ab'+'c' == 'a'+'bc'), so a captured
+    signature authorizes a DIFFERENT call (boundary malleability).
+    Canonical JSON is injective on the params structure."""
+    return json.dumps(
+        args, sort_keys=True, separators=(",", ":"), default=str
+    )
+
+
+# one-shot signature tracking: a valid (signature, timestamp) pair is
+# accepted ONCE — replaying a captured wallet-spending request within the
+# 30-minute window must not spend again (divergence from the reference,
+# which accepts unlimited replays inside the window)
+_seen_signatures: Dict[str, float] = {}
+_seen_lock = threading.Lock()
 
 
 def check_private_auth(
@@ -86,7 +88,9 @@ def check_private_auth(
 ) -> bool:
     """Reference HttpService._CheckAuth (cs:227-279): the caller signs
     keccak(method + serialized_params + timestamp) with the operator key;
-    the recovered compressed pubkey must equal the configured one."""
+    the recovered compressed pubkey must equal the configured one.
+    Hardened over the reference: canonical-JSON params (no boundary
+    malleability) and one-shot signatures (no in-window replay)."""
     import time
 
     from ..crypto import ecdsa
@@ -98,7 +102,8 @@ def check_private_auth(
         ts = int(timestamp.strip())
     except ValueError:
         return False
-    if abs(time.time() - ts) >= AUTH_WINDOW_SECONDS:
+    now = time.time()
+    if abs(now - ts) >= AUTH_WINDOW_SECONDS:
         return False
     msg = (method + serialize_params(params) + timestamp.strip()).encode()
     try:
@@ -108,9 +113,21 @@ def check_private_auth(
         return False
     if pub is None:
         return False
-    return hmac.compare_digest(
+    if not hmac.compare_digest(
         pub.hex(), auth_pubkey.removeprefix("0x").lower()
-    )
+    ):
+        return False
+    with _seen_lock:
+        # prune expired entries, then enforce one-shot use
+        if len(_seen_signatures) > 4096:
+            cutoff = now - AUTH_WINDOW_SECONDS
+            for k, exp in list(_seen_signatures.items()):
+                if exp < cutoff:
+                    del _seen_signatures[k]
+        if signature in _seen_signatures:
+            return False
+        _seen_signatures[signature] = now
+    return True
 
 
 class JsonRpcServer:

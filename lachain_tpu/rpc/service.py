@@ -1324,12 +1324,12 @@ class RpcService:
         return int(_metrics.counter_value("fastsync_nodes_downloaded"))
 
     def _height_for_tag(self, tag):
-        if tag in ("latest", "pending", None):
-            return self.node.block_manager.current_height()
-        if tag == "earliest":
-            return 0
+        # _tag_to_height with a None-on-garbage contract (the version-keyed
+        # family returns "0x"/None for unknown tags instead of erroring)
         try:
-            return _unhex(tag)
+            return self._tag_to_height(
+                tag, self.node.block_manager.current_height()
+            )
         except Exception:
             return None
 

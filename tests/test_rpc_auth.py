@@ -169,3 +169,26 @@ def test_browser_origin_gates_loopback_privates():
             await srv.stop()
 
     asyncio.run(run())
+
+
+def test_signature_replay_rejected():
+    """One-shot signatures: the same (signature, timestamp) pair must not
+    authorize twice — replaying a captured wallet-spending request would
+    otherwise spend once per replay for 30 minutes."""
+    params = ["0x" + "ab" * 20]
+    sig, ts = _sign("fe_sendTransaction", params)
+    assert check_private_auth(OP_PUB, "fe_sendTransaction", params, sig, ts)
+    assert not check_private_auth(
+        OP_PUB, "fe_sendTransaction", params, sig, ts
+    )
+
+
+def test_param_boundary_malleability_rejected():
+    """Canonical-JSON digest: moving bytes across a param boundary must
+    invalidate the signature (the reference's delimiter-free concatenation
+    accepts it)."""
+    sig, ts = _sign("sendContract", ["0xaa", "transfer(address,uint256)"])
+    assert not check_private_auth(
+        OP_PUB, "sendContract", ["0xaatransfer(address,", "uint256)"],
+        sig, ts,
+    )
