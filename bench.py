@@ -75,10 +75,12 @@ def main() -> None:
     ct0, h0, decs0, _ = slots[0]
     uis = [d.ui for d in decs0[:sample]]
     yis = y_points[:sample]
-    t0 = time.perf_counter()
-    oks = backend.tpke_verify_shares_serial(uis, yis, h0, ct0.w)
-    per_share_s = (time.perf_counter() - t0) / sample
-    assert all(oks)
+    per_share_s = 1e9
+    for _ in range(3):  # min-of-3: the tunnel/chip load varies 25%+ run-to-run
+        t0 = time.perf_counter()
+        oks = backend.tpke_verify_shares_serial(uis, yis, h0, ct0.w)
+        per_share_s = min(per_share_s, (time.perf_counter() - t0) / sample)
+        assert all(oks)
     # serial per-slot combine: F+1 scalar muls + adds (per-op native calls,
     # mirroring the reference's per-op MCL loop)
     xs = [d.decryptor_id + 1 for d in decs0[: f + 1]]
@@ -133,6 +135,7 @@ def main() -> None:
     run_once()  # warmup/compile (not timed)
     times = [run_once() for _ in range(reps)]
     tpu_s = min(times)
+    spread = (max(times) - min(times)) / min(times) if min(times) else 0.0
 
     result = {
         "metric": "tpke_verify_combine_shares_per_s",
@@ -144,6 +147,10 @@ def main() -> None:
         "baseline_per_share_ms": round(per_share_s * 1000, 3),
         "backend": jax.devices()[0].platform,
         "n_validators": n,
+        # driver-visible variance: the axon tunnel's load swings trial
+        # times by 25%+; deltas inside the spread are noise, not regressions
+        "trials_s": [round(t, 4) for t in times],
+        "trial_spread_pct": round(spread * 100, 1),
     }
     print(json.dumps(result))
 

@@ -91,11 +91,19 @@ class TpuBackend:
         # the host pipeline even when a chip is present: per-call device
         # overhead (the axon tunnel charges ~0.1 s fixed) plus one-time
         # per-shape Mosaic compiles dwarf the host cost of tiny batches.
-        # The S x K era shapes the kernels exist for (N=64 -> 4096 lanes)
-        # clear this easily.
+        #
+        # Round-5 remeasurement (results_r05.json tpu_era_negative): after
+        # the host gained the ADX multiplier, Straus/GLV MSM and
+        # ciphertext-grouped pairing folds, the host flushes a FULL N=64
+        # era batch (4096 lanes) in ~40 ms — under the tunnel's 88 ms
+        # round-trip floor alone (kernel exec adds ~190 ms; the marshal,
+        # the round-4 suspect, measures 28 ms vectorized). The default
+        # therefore routes ALL era shapes to the host; the kernels stay
+        # behind this env knob for hardware where the transport is not the
+        # bound (co-located chips, multi-chip meshes) and for bench.py.
         if min_device_lanes is None:
             min_device_lanes = int(
-                os.environ.get("LTPU_TPU_MIN_LANES", "1024")
+                os.environ.get("LTPU_TPU_MIN_LANES", "1000000")
             )
         self.min_device_lanes = min_device_lanes
         if host_backend is None:

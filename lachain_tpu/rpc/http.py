@@ -68,17 +68,27 @@ def serialize_params(args) -> str:
     concatenates keys/values with NO delimiters: there, distinct param
     splits collide to the same string ('ab'+'c' == 'a'+'bc'), so a captured
     signature authorizes a DIFFERENT call (boundary malleability).
-    Canonical JSON is injective on the params structure."""
+    Canonical JSON is injective on the params structure.
+
+    WIRE-PROTOCOL NOTE: tooling that signs with the reference's scheme is
+    incompatible by construction — operators sign with this function (the
+    console and DEPLOY.md document the recipe). The break is deliberate:
+    a malleable digest cannot be grandfathered into an auth scheme."""
     return json.dumps(
         args, sort_keys=True, separators=(",", ":"), default=str
     )
 
 
-# one-shot signature tracking: a valid (signature, timestamp) pair is
-# accepted ONCE — replaying a captured wallet-spending request within the
-# 30-minute window must not spend again (divergence from the reference,
-# which accepts unlimited replays inside the window)
-_seen_signatures: Dict[str, float] = {}
+# One-shot signature tracking: a valid signature is accepted ONCE —
+# replaying a captured wallet-spending request within the 30-minute window
+# must not spend again (divergence from the reference, which accepts
+# unlimited replays inside the window). Keyed on the PARSED signature
+# bytes, so re-encodings (case, 0x prefix) of the same signature cannot
+# bypass the cache. Side effect by design: byte-identical repeats of the
+# same private call within one second (RFC 6979 signing is deterministic,
+# timestamps have 1 s granularity) also dedupe — clients needing rapid
+# identical private calls must vary a params nonce.
+_seen_signatures: Dict[bytes, float] = {}  # sig bytes -> expiry (ts+window)
 _seen_lock = threading.Lock()
 
 
@@ -107,7 +117,7 @@ def check_private_auth(
         return False
     msg = (method + serialize_params(params) + timestamp.strip()).encode()
     try:
-        sig = bytes.fromhex(signature.removeprefix("0x"))
+        sig = bytes.fromhex(signature.lower().removeprefix("0x"))
         pub = ecdsa.recover_hash(keccak256(msg), sig)
     except Exception:
         return False
@@ -118,15 +128,16 @@ def check_private_auth(
     ):
         return False
     with _seen_lock:
-        # prune expired entries, then enforce one-shot use
-        if len(_seen_signatures) > 4096:
-            cutoff = now - AUTH_WINDOW_SECONDS
+        # prune by the SIGNED timestamp's expiry (a future-dated signature
+        # stays blocked for its whole validity window, not just until the
+        # server-side acceptance time ages out)
+        if len(_seen_signatures) > 1024:
             for k, exp in list(_seen_signatures.items()):
-                if exp < cutoff:
+                if exp <= now:
                     del _seen_signatures[k]
-        if signature in _seen_signatures:
+        if sig in _seen_signatures:
             return False
-        _seen_signatures[signature] = now
+        _seen_signatures[sig] = ts + AUTH_WINDOW_SECONDS
     return True
 
 

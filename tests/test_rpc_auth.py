@@ -192,3 +192,15 @@ def test_param_boundary_malleability_rejected():
         OP_PUB, "sendContract", ["0xaatransfer(address,", "uint256)"],
         sig, ts,
     )
+
+
+def test_replay_rejected_across_reencodings():
+    """The one-shot cache keys on parsed signature BYTES: uppercased or
+    prefix-stripped copies of a captured signature must not bypass it."""
+    params = ["0x" + "cd" * 20]
+    sig, ts = _sign("deployContract", params)
+    assert check_private_auth(OP_PUB, "deployContract", params, sig, ts)
+    for variant in (sig.upper(), "0x" + sig, "0X" + sig.upper()):
+        assert not check_private_auth(
+            OP_PUB, "deployContract", params, variant, ts
+        ), variant
