@@ -62,12 +62,16 @@ async def run(args) -> dict:
     total_mined = 0
     times = []
     nonces = [0] * len(users)
+    # pre-sign every era's txs in setup (signing is not the measured
+    # pipeline; gossip/pool ingest still happens per era)
+    presigned = []
     for era in range(1, args.eras + 1):
+        batch = []
         for k in range(args.txs):
             u = k % len(users)
-            stx = sign_transaction(
+            batch.append(sign_transaction(
                 Transaction(
-                    to=bytes([era]) * 20,
+                    to=bytes([era % 250 + 1]) * 20,
                     value=1,
                     nonce=nonces[u],
                     gas_price=1 + (k % 7),
@@ -75,11 +79,22 @@ async def run(args) -> dict:
                 ),
                 users[u],
                 chain,
-            )
+            ))
             nonces[u] += 1
+        presigned.append(batch)
+    for era in range(1, args.eras + 1):
+        batch = presigned[era - 1]
+        presigned[era - 1] = None  # release: 200k live txs otherwise
+        for stx in batch:
             for node in nodes:
                 node.pool.add(stx)  # pre-distributed (gossip not timed)
-        await asyncio.sleep(0.3)
+        if era % 50 == 0 and times:
+            # progress to STDERR: stdout stays the ONE-json-line contract
+            print(json.dumps({"eras_completed": len(times),
+                              "interval_max_s": round(max(times), 3),
+                              "interval_mean_s": round(sum(times)/len(times), 3)}),
+                  file=sys.stderr, flush=True)
+        await asyncio.sleep(args.sleep)
         t0 = time.perf_counter()
         blocks = await asyncio.gather(*(v.run_era(era) for v in nodes))
         times.append(time.perf_counter() - t0)
@@ -87,6 +102,7 @@ async def run(args) -> dict:
     for node in nodes:
         await node.stop()
     era_s = min(times)
+    s_times = sorted(times)
     return {
         "metric": "devnet_tcp_block_latency_s",
         "value": round(era_s, 3),
@@ -94,6 +110,15 @@ async def run(args) -> dict:
         "blocks_per_s": round(1.0 / era_s, 3),
         "mined_tx_per_s": round(total_mined / sum(times), 1),
         "txs_per_block": total_mined // args.eras,
+        # the reference's production contract is a 5000 ms target interval
+        # (ConsensusManager.cs:78): sustained means EVERY block, not the min
+        "blocks": len(times),
+        "interval_max_s": round(max(times), 3),
+        "interval_mean_s": round(sum(times) / len(times), 3),
+        "interval_p95_s": round(
+            s_times[max(0, -(-len(s_times) * 95 // 100) - 1)], 3
+        ),  # nearest-rank ceil(0.95n)-1
+        "sustained_under_5s": max(times) <= 5.0,
     }
 
 
@@ -101,6 +126,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--txs", type=int, default=1000)
     ap.add_argument("--eras", type=int, default=3)
+    # settle gap between submission and the timed era (drains flush
+    # workers; not part of the measured block interval)
+    ap.add_argument("--sleep", type=float, default=0.3)
     args = ap.parse_args()
     print(json.dumps(asyncio.run(run(args))))
 
