@@ -30,6 +30,7 @@ class Rng:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--txs", type=int, default=10_000)
+    ap.add_argument("--store", choices=["sqlite", "lsm"], default="sqlite")
     args = ap.parse_args()
 
     from lachain_tpu.core import system_contracts
@@ -44,6 +45,7 @@ def main() -> None:
     )
     from lachain_tpu.crypto import ecdsa
     from lachain_tpu.storage.kv import SqliteKV
+    from lachain_tpu.storage.lsm import LsmKV
     from lachain_tpu.storage.state import StateManager
 
     chain = 515
@@ -51,7 +53,11 @@ def main() -> None:
     addrs = [ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)) for u in users]
 
     with tempfile.TemporaryDirectory() as tmp:
-        kv = SqliteKV(os.path.join(tmp, "bench.db"))
+        kv = (
+            LsmKV(os.path.join(tmp, "bench.lsm"))
+            if args.store == "lsm"
+            else SqliteKV(os.path.join(tmp, "bench.db"))
+        )
         state = StateManager(kv)
         bm = BlockManager(kv, state, system_contracts.make_executer(chain))
         bm.build_genesis({a: 10**24 for a in addrs}, chain)
@@ -109,7 +115,11 @@ def main() -> None:
                 "emulate_s": round(t_emulate, 3),
                 "tx_per_s_commit": round(len(txs) / t_commit, 1),
                 "raw_batch_10k_puts_s": round(t_raw, 3),
-                "store": "SqliteKV WAL synchronous=FULL batches",
+                "store": (
+                    "LsmKV native WAL+SST engine"
+                    if args.store == "lsm"
+                    else "SqliteKV WAL synchronous=FULL batches"
+                ),
             }
         )
     )

@@ -137,6 +137,7 @@ def _build_node(cfg, config_path=None):
     from .core.vault import PrivateWallet
     from .network.hub import PeerAddress
     from .storage.kv import SqliteKV
+    from .storage.lsm import LsmKV
 
     sc.set_cycle_params(
         cfg.staking.cycle_duration,
@@ -166,7 +167,11 @@ def _build_node(cfg, config_path=None):
         public_keys=pub,
         private_keys=priv,
         chain_id=cfg.genesis.chain_id,
-        kv=SqliteKV(db_path) if db_path else None,
+        kv=(
+            (LsmKV if cfg.storage_engine == "lsm" else SqliteKV)(db_path)
+            if db_path
+            else None
+        ),
         host=cfg.network.host,
         port=cfg.network.port,
         advertise_host=cfg.network.advertise_host,
@@ -435,6 +440,7 @@ def cmd_db(args) -> int:
     with respect to concurrent commits (storage/shrink.py docstring)."""
     from .core.config import NodeConfig
     from .storage.kv import SqliteKV
+    from .storage.lsm import LsmKV
     from .storage.shrink import DbShrink
     from .storage.state import StateManager
 
@@ -445,7 +451,9 @@ def cmd_db(args) -> int:
     if not os.path.exists(db_path):
         print(f"no database at {db_path}", file=sys.stderr)
         return 1
-    kv = SqliteKV(db_path)
+    # same engine switch as the node itself: maintenance verbs must open
+    # the store the node actually wrote
+    kv = (LsmKV if cfg.storage_engine == "lsm" else SqliteKV)(db_path)
     state = StateManager(kv)
     if args.db_cmd == "shrink":
         stats = DbShrink(state, kv).shrink(args.retain)

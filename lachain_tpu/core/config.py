@@ -190,6 +190,18 @@ class NodeConfig:
     def storage_path(self) -> Optional[str]:
         return self.raw.get("storage", {}).get("path")
 
+    @property
+    def storage_engine(self) -> str:
+        """"sqlite" (default) or "lsm" (the native C++ LSM engine).
+        Unknown names are a hard error: silently falling back to sqlite
+        would rebuild a fresh chain from genesis on a typo."""
+        engine = self.raw.get("storage", {}).get("engine", "sqlite")
+        if engine not in ("sqlite", "lsm"):
+            raise ValueError(
+                f"unknown storage.engine {engine!r} (use 'sqlite' or 'lsm')"
+            )
+        return engine
+
     @classmethod
     def from_dict(cls, cfg: dict) -> "NodeConfig":
         cfg = migrate(cfg)

@@ -1,0 +1,168 @@
+"""LsmKV — the native LSM storage engine behind the KVStore seam.
+
+Role of the reference's RocksDB context
+(/root/reference/src/Lachain.Storage/RocksDbContext.cs:23-60): a log-
+structured KV store with WAL-synced atomic batches. The engine itself is
+C++ (storage/native/lsm.cpp): CRC-framed fsynced WAL -> sorted memtable ->
+immutable sorted tables + manifest, full compaction. Durability contract
+matches SqliteKV's synchronous=FULL batches (same kill -9 guarantees,
+tests/test_lsm.py + test_storage_crash shape).
+
+Single-op put/delete are WAL-synced one-op batches — same semantics as
+SqliteKV's autocommit puts, with the fsync cost that implies; bulk paths
+use write_batch exactly as they do over SqliteKV.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from .kv import KVStore
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libllsm.so")
+_lib_cache: list = [None]
+
+
+def _load_lib():
+    if _lib_cache[0] is not None:
+        return _lib_cache[0]
+    sources = [
+        os.path.join(_NATIVE_DIR, "lsm.cpp"),
+        os.path.join(_NATIVE_DIR, "Makefile"),
+    ]
+    if not os.path.exists(_LIB_PATH) or any(
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
+    ):
+        subprocess.run(
+            ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
+        )
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.lsm_open.restype = ctypes.c_void_p
+    lib.lsm_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.lsm_close.argtypes = [ctypes.c_void_p]
+    lib.lsm_write_batch.restype = ctypes.c_int
+    lib.lsm_write_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.lsm_get.restype = ctypes.c_int
+    lib.lsm_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.lsm_scan_prefix.restype = ctypes.c_int
+    lib.lsm_scan_prefix.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.lsm_flush.restype = ctypes.c_int
+    lib.lsm_flush.argtypes = [ctypes.c_void_p]
+    lib.lsm_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+    lib.lsm_table_count.restype = ctypes.c_uint64
+    lib.lsm_table_count.argtypes = [ctypes.c_void_p]
+    lib.lsm_version.restype = ctypes.c_int
+    assert lib.lsm_version() == 1
+    _lib_cache[0] = lib
+    return lib
+
+
+def _encode_batch(
+    puts: List[Tuple[bytes, bytes]], deletes: List[bytes]
+) -> bytes:
+    parts = [(len(puts) + len(deletes)).to_bytes(4, "little")]
+    for k, v in puts:
+        parts.append(
+            b"\x00" + len(k).to_bytes(4, "little") + k
+            + len(v).to_bytes(4, "little") + v
+        )
+    for k in deletes:
+        parts.append(
+            b"\x01" + len(k).to_bytes(4, "little") + k + b"\x00\x00\x00\x00"
+        )
+    return b"".join(parts)
+
+
+class LsmKV(KVStore):
+    """Durable KV on the native LSM engine (drop-in for SqliteKV)."""
+
+    def __init__(self, path: str, flush_threshold: int = 8 << 20):
+        self._lib = _load_lib()
+        self._lock = threading.Lock()
+        self._h = self._lib.lsm_open(path.encode(), flush_threshold)
+        if not self._h:
+            raise IOError(f"cannot open LSM store at {path!r}")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        val = ctypes.POINTER(ctypes.c_ubyte)()
+        vlen = ctypes.c_size_t(0)
+        r = self._lib.lsm_get(
+            self._h, key, len(key), ctypes.byref(val), ctypes.byref(vlen)
+        )
+        if r != 1:
+            return None
+        try:
+            return ctypes.string_at(val, vlen.value)
+        finally:
+            self._lib.lsm_free(val)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.write_batch([(key, value)])
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch([], [key])
+
+    def write_batch(
+        self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()
+    ) -> None:
+        payload = _encode_batch(list(puts), list(deletes))
+        with self._lock:
+            if self._lib.lsm_write_batch(self._h, payload, len(payload)) != 0:
+                raise IOError("LSM write_batch failed")
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        blen = ctypes.c_size_t(0)
+        if (
+            self._lib.lsm_scan_prefix(
+                self._h, prefix, len(prefix),
+                ctypes.byref(buf), ctypes.byref(blen),
+            )
+            != 0
+        ):
+            raise IOError("LSM scan failed")
+        try:
+            data = ctypes.string_at(buf, blen.value)
+        finally:
+            self._lib.lsm_free(buf)
+        off = 4
+        count = int.from_bytes(data[0:4], "little")
+        for _ in range(count):
+            klen = int.from_bytes(data[off : off + 4], "little")
+            off += 4
+            k = data[off : off + klen]
+            off += klen
+            vlen = int.from_bytes(data[off : off + 4], "little")
+            off += 4
+            v = data[off : off + vlen]
+            off += vlen
+            yield (k, v)
+
+    def flush(self) -> None:
+        """Force the memtable into a durable sorted table."""
+        with self._lock:
+            if self._lib.lsm_flush(self._h) != 0:
+                raise IOError("LSM flush failed")
+
+    def table_count(self) -> int:
+        return int(self._lib.lsm_table_count(self._h))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.lsm_close(self._h)
+                self._h = None

@@ -1,0 +1,555 @@
+// Native LSM storage engine — the role of the reference's RocksDB
+// (/root/reference/src/Lachain.Storage/RocksDbContext.cs:23-60: one KV
+// store, WAL-synced writes, atomic batches), re-designed small instead of
+// vendored: a write-ahead log + sorted memtable + immutable sorted tables
+// with full compaction and an atomically-rewritten manifest.
+//
+// Durability contract (matches SqliteKV's synchronous=FULL batches, which
+// tests/test_storage_crash.py pins):
+//   * write_batch appends ONE WAL record (CRC-framed) and fsyncs before
+//     applying to the memtable — a batch is all-or-nothing across kill -9.
+//   * memtable flush: SST written + fsynced, manifest rewritten via
+//     tmp+rename+dir-fsync, and ONLY THEN the WAL is truncated. A crash at
+//     any point replays the WAL over the previous manifest state.
+//   * torn WAL tail (partial record / bad CRC) is discarded on open —
+//     exactly the uncommitted batch.
+//
+// Reads: memtable, then tables newest->oldest (per-table sorted in-memory
+// key index, values read with pread). Compaction: when the table count
+// exceeds a threshold, ALL tables merge into one (newest wins; tombstones
+// drop — nothing older can resurrect).
+//
+// Python binding: storage/lsm.py (ctypes). The batch wire format Python
+// sends IS the WAL payload format, so the engine appends it verbatim.
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+typedef uint8_t u8;
+typedef uint32_t u32;
+typedef uint64_t u64;
+
+// CRC32 (IEEE, table-driven)
+static u32 CRC_TAB[256];
+static void crc_init() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  for (u32 i = 0; i < 256; i++) {
+    u32 c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    CRC_TAB[i] = c;
+  }
+}
+static u32 crc32(const u8* p, size_t n) {
+  u32 c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = CRC_TAB[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+static void put_u32(std::string& s, u32 v) {
+  for (int i = 0; i < 4; i++) s.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+static u32 get_u32(const u8* p) {
+  return (u32)p[0] | ((u32)p[1] << 8) | ((u32)p[2] << 16) | ((u32)p[3] << 24);
+}
+static void put_u64(std::string& s, u64 v) {
+  for (int i = 0; i < 8; i++) s.push_back((char)((v >> (8 * i)) & 0xFF));
+}
+static u64 get_u64(const u8* p) {
+  u64 v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+static bool fsync_path(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  bool ok = ::fsync(fd) == 0;
+  ::close(fd);
+  return ok;
+}
+
+// batch payload: u32 count, then per op u8 type(0 put/1 del), u32 klen,
+// key, u32 vlen, val (vlen=0 for deletes)
+struct Op {
+  bool del;
+  std::string key, val;
+};
+
+static bool parse_batch(const u8* p, size_t n, std::vector<Op>& out) {
+  if (n < 4) return false;
+  u32 count = get_u32(p);
+  size_t off = 4;
+  out.clear();
+  out.reserve(count);
+  for (u32 i = 0; i < count; i++) {
+    if (off + 5 > n) return false;
+    u8 type = p[off];
+    off += 1;
+    u32 klen = get_u32(p + off);
+    off += 4;
+    if (off + klen + 4 > n) return false;
+    std::string key((const char*)p + off, klen);
+    off += klen;
+    u32 vlen = get_u32(p + off);
+    off += 4;
+    if (off + vlen > n) return false;
+    std::string val((const char*)p + off, vlen);
+    off += vlen;
+    out.push_back(Op{type == 1, std::move(key), std::move(val)});
+  }
+  return off == n;
+}
+
+// ---------------------------------------------------------------------------
+// SSTable: [magic "LSST"][entries: u8 type, u32 klen, key, u32 vlen, val]*
+//          [index: (u32 klen, key, u64 entry_off, u8 type, u32 vlen)*]
+//          [u64 index_off][u32 index_count][u32 crc_of_index][magic "TSSL"]
+// ---------------------------------------------------------------------------
+
+struct TableEntry {
+  std::string key;
+  u64 off;    // offset of the VALUE bytes in the file
+  u32 vlen;
+  bool del;
+};
+
+struct Table {
+  std::string path;
+  int fd = -1;
+  std::vector<TableEntry> index;  // sorted by key
+
+  const TableEntry* find(const std::string& key) const {
+    auto it = std::lower_bound(
+        index.begin(), index.end(), key,
+        [](const TableEntry& e, const std::string& k) { return e.key < k; });
+    if (it == index.end() || it->key != key) return nullptr;
+    return &*it;
+  }
+};
+
+static bool write_table(const std::string& path,
+                        const std::map<std::string, std::pair<bool, std::string>>& items,
+                        bool drop_tombstones) {
+  std::string body = "LSST";
+  std::string index;
+  u32 count = 0;
+  for (auto& kv : items) {
+    bool del = kv.second.first;
+    if (del && drop_tombstones) continue;
+    const std::string& val = kv.second.second;
+    u64 entry_off;
+    body.push_back(del ? 1 : 0);
+    put_u32(body, (u32)kv.first.size());
+    body += kv.first;
+    put_u32(body, (u32)val.size());
+    entry_off = body.size();
+    body += val;
+    put_u32(index, (u32)kv.first.size());
+    index += kv.first;
+    put_u64(index, entry_off);
+    index.push_back(del ? 1 : 0);
+    put_u32(index, (u32)val.size());
+    count++;
+  }
+  u64 index_off = body.size();
+  std::string footer;
+  put_u64(footer, index_off);
+  put_u32(footer, count);
+  put_u32(footer, crc32((const u8*)index.data(), index.size()));
+  footer += "TSSL";
+  std::string all = body + index + footer;
+  std::string tmp = path + ".tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  size_t done = 0;
+  while (done < all.size()) {
+    ssize_t w = ::write(fd, all.data() + done, all.size() - done);
+    if (w <= 0) {
+      ::close(fd);
+      return false;
+    }
+    done += (size_t)w;
+  }
+  if (::fsync(fd) != 0) {
+    ::close(fd);
+    return false;
+  }
+  ::close(fd);
+  if (::rename(tmp.c_str(), path.c_str()) != 0) return false;
+  return true;
+}
+
+static bool load_table(Table& t) {
+  t.fd = ::open(t.path.c_str(), O_RDONLY);
+  if (t.fd < 0) return false;
+  off_t size = ::lseek(t.fd, 0, SEEK_END);
+  if (size < (off_t)(4 + 20)) return false;
+  u8 footer[20];
+  if (::pread(t.fd, footer, 20, size - 20) != 20) return false;
+  if (memcmp(footer + 16, "TSSL", 4) != 0) return false;
+  u64 index_off = get_u64(footer);
+  u32 count = get_u32(footer + 8);
+  u32 want_crc = get_u32(footer + 12);
+  if (index_off > (u64)size - 20) return false;
+  size_t index_len = (size_t)((u64)size - 20 - index_off);
+  std::vector<u8> ibuf(index_len);
+  if (index_len &&
+      ::pread(t.fd, ibuf.data(), index_len, (off_t)index_off) != (ssize_t)index_len)
+    return false;
+  if (crc32(ibuf.data(), index_len) != want_crc) return false;
+  t.index.clear();
+  t.index.reserve(count);
+  size_t off = 0;
+  for (u32 i = 0; i < count; i++) {
+    if (off + 4 > index_len) return false;
+    u32 klen = get_u32(ibuf.data() + off);
+    off += 4;
+    if (off + klen + 13 > index_len) return false;
+    TableEntry e;
+    e.key.assign((const char*)ibuf.data() + off, klen);
+    off += klen;
+    e.off = get_u64(ibuf.data() + off);
+    off += 8;
+    e.del = ibuf[off] == 1;
+    off += 1;
+    e.vlen = get_u32(ibuf.data() + off);
+    off += 4;
+    t.index.push_back(std::move(e));
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+struct Lsm {
+  std::string dir;
+  int wal_fd = -1;
+  u64 next_seq = 1;
+  size_t memtable_bytes = 0;
+  size_t flush_threshold = 8u << 20;   // 8 MB memtable
+  size_t compact_tables = 6;           // full-compact beyond this many
+  std::map<std::string, std::pair<bool, std::string>> mem;  // key -> (del, val)
+  std::vector<Table> tables;  // oldest .. newest
+  std::mutex mu;
+
+  std::string wal_path() const { return dir + "/wal.log"; }
+  std::string manifest_path() const { return dir + "/MANIFEST"; }
+  std::string table_path(u64 seq) const {
+    char buf[32];
+    snprintf(buf, sizeof buf, "/sst_%012llu.dat", (unsigned long long)seq);
+    return dir + buf;
+  }
+
+  bool write_manifest() {
+    std::string body;
+    for (auto& t : tables) {
+      size_t slash = t.path.rfind('/');
+      body += t.path.substr(slash + 1);
+      body.push_back('\n');
+    }
+    std::string tmp = manifest_path() + ".tmp";
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return false;
+    if (::write(fd, body.data(), body.size()) != (ssize_t)body.size() ||
+        ::fsync(fd) != 0) {
+      ::close(fd);
+      return false;
+    }
+    ::close(fd);
+    if (::rename(tmp.c_str(), manifest_path().c_str()) != 0) return false;
+    return fsync_path(dir);
+  }
+
+  bool apply_ops(const std::vector<Op>& ops) {
+    for (auto& op : ops) {
+      auto it = mem.find(op.key);
+      if (it != mem.end())
+        memtable_bytes -= it->first.size() + it->second.second.size();
+      memtable_bytes += op.key.size() + op.val.size();
+      mem[op.key] = {op.del, op.val};
+    }
+    return true;
+  }
+
+  bool open_dirs() {
+    crc_init();
+    ::mkdir(dir.c_str(), 0755);
+    // manifest -> tables
+    tables.clear();
+    FILE* mf = fopen(manifest_path().c_str(), "r");
+    if (mf) {
+      char line[256];
+      while (fgets(line, sizeof line, mf)) {
+        size_t n = strlen(line);
+        while (n && (line[n - 1] == '\n' || line[n - 1] == '\r')) line[--n] = 0;
+        if (!n) continue;
+        Table t;
+        t.path = dir + "/" + line;
+        if (!load_table(t)) {
+          fclose(mf);
+          return false;  // manifest names an unreadable table: refuse
+        }
+        // track the highest sequence for next_seq
+        unsigned long long seq = 0;
+        sscanf(line, "sst_%012llu.dat", &seq);
+        if (seq >= next_seq) next_seq = seq + 1;
+        tables.push_back(std::move(t));
+      }
+      fclose(mf);
+    }
+    // WAL replay: CRC-framed records; stop at the first bad one
+    int rfd = ::open(wal_path().c_str(), O_RDONLY);
+    if (rfd >= 0) {
+      off_t size = ::lseek(rfd, 0, SEEK_END);
+      std::vector<u8> buf((size_t)size);
+      if (size > 0) {
+        if (::pread(rfd, buf.data(), (size_t)size, 0) != (ssize_t)size) {
+          ::close(rfd);
+          return false;
+        }
+      }
+      ::close(rfd);
+      size_t off = 0;
+      while (off + 8 <= buf.size()) {
+        u32 crc = get_u32(buf.data() + off);
+        u32 len = get_u32(buf.data() + off + 4);
+        if (off + 8 + len > buf.size()) break;  // torn tail
+        if (crc32(buf.data() + off + 8, len) != crc) break;
+        std::vector<Op> ops;
+        if (!parse_batch(buf.data() + off + 8, len, ops)) break;
+        apply_ops(ops);
+        off += 8 + len;
+      }
+    }
+    wal_fd = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    return wal_fd >= 0;
+  }
+
+  bool flush_memtable() {
+    if (mem.empty()) return true;
+    u64 seq = next_seq++;
+    std::string path = table_path(seq);
+    // tombstones must persist unless this becomes the ONLY table
+    bool only = tables.empty();
+    if (!write_table(path, mem, /*drop_tombstones=*/only)) return false;
+    Table t;
+    t.path = path;
+    if (!load_table(t)) return false;
+    tables.push_back(std::move(t));
+    if (!write_manifest()) return false;
+    // WAL content is now durable in the table: truncate
+    ::close(wal_fd);
+    wal_fd = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (wal_fd < 0) return false;
+    if (::fsync(wal_fd) != 0) return false;
+    mem.clear();
+    memtable_bytes = 0;
+    if (tables.size() > compact_tables) return compact();
+    return true;
+  }
+
+  bool compact() {
+    // full merge, newest wins; tombstones drop (nothing older remains)
+    std::map<std::string, std::pair<bool, std::string>> merged;
+    for (auto& t : tables) {  // oldest -> newest: later overwrites earlier
+      for (auto& e : t.index) {
+        if (e.del) {
+          merged[e.key] = {true, std::string()};
+        } else {
+          std::string val(e.vlen, '\0');
+          if (e.vlen &&
+              ::pread(t.fd, &val[0], e.vlen, (off_t)e.off) != (ssize_t)e.vlen)
+            return false;
+          merged[e.key] = {false, std::move(val)};
+        }
+      }
+    }
+    u64 seq = next_seq++;
+    std::string path = table_path(seq);
+    if (!write_table(path, merged, /*drop_tombstones=*/true)) return false;
+    Table t;
+    t.path = path;
+    if (!load_table(t)) return false;
+    std::vector<Table> old;
+    old.swap(tables);
+    tables.push_back(std::move(t));
+    if (!write_manifest()) return false;
+    for (auto& o : old) {
+      if (o.fd >= 0) ::close(o.fd);
+      ::unlink(o.path.c_str());
+    }
+    return true;
+  }
+
+  bool write_batch(const u8* payload, size_t len) {
+    std::lock_guard<std::mutex> g(mu);
+    std::vector<Op> ops;
+    if (!parse_batch(payload, len, ops)) return false;
+    std::string rec;
+    put_u32(rec, crc32(payload, len));
+    put_u32(rec, (u32)len);
+    rec.append((const char*)payload, len);
+    size_t done = 0;
+    while (done < rec.size()) {
+      ssize_t w = ::write(wal_fd, rec.data() + done, rec.size() - done);
+      if (w <= 0) return false;
+      done += (size_t)w;
+    }
+    if (::fsync(wal_fd) != 0) return false;
+    apply_ops(ops);
+    if (memtable_bytes >= flush_threshold) return flush_memtable();
+    return true;
+  }
+
+  // 1 found, 0 missing
+  int get(const std::string& key, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = mem.find(key);
+    if (it != mem.end()) {
+      if (it->second.first) return 0;
+      out = it->second.second;
+      return 1;
+    }
+    for (auto t = tables.rbegin(); t != tables.rend(); ++t) {
+      const TableEntry* e = t->find(key);
+      if (e == nullptr) continue;
+      if (e->del) return 0;
+      out.assign(e->vlen, '\0');
+      if (e->vlen &&
+          ::pread(t->fd, &out[0], e->vlen, (off_t)e->off) != (ssize_t)e->vlen)
+        return 0;
+      return 1;
+    }
+    return 0;
+  }
+
+  bool scan_prefix(const std::string& prefix, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    std::map<std::string, std::pair<bool, std::string>> found;
+    for (auto& t : tables) {  // oldest -> newest
+      auto it = std::lower_bound(
+          t.index.begin(), t.index.end(), prefix,
+          [](const TableEntry& e, const std::string& k) { return e.key < k; });
+      for (; it != t.index.end(); ++it) {
+        if (it->key.compare(0, prefix.size(), prefix) != 0) break;
+        if (it->del) {
+          found[it->key] = {true, std::string()};
+        } else {
+          std::string val(it->vlen, '\0');
+          if (it->vlen && ::pread(t.fd, &val[0], it->vlen, (off_t)it->off) !=
+                              (ssize_t)it->vlen)
+            return false;
+          found[it->key] = {false, std::move(val)};
+        }
+      }
+    }
+    for (auto it = mem.lower_bound(prefix); it != mem.end(); ++it) {
+      if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+      found[it->first] = it->second;
+    }
+    out.clear();
+    u32 count = 0;
+    std::string body;
+    for (auto& kv : found) {
+      if (kv.second.first) continue;  // tombstone
+      put_u32(body, (u32)kv.first.size());
+      body += kv.first;
+      put_u32(body, (u32)kv.second.second.size());
+      body += kv.second.second;
+      count++;
+    }
+    put_u32(out, count);
+    out += body;
+    return true;
+  }
+
+  void close_all() {
+    std::lock_guard<std::mutex> g(mu);
+    // durable by construction (WAL fsynced per batch); just release fds
+    if (wal_fd >= 0) ::close(wal_fd);
+    wal_fd = -1;
+    for (auto& t : tables)
+      if (t.fd >= 0) ::close(t.fd);
+    tables.clear();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lsm_open(const char* dir, u64 flush_threshold) {
+  Lsm* db = new Lsm();
+  db->dir = dir;
+  if (flush_threshold) db->flush_threshold = (size_t)flush_threshold;
+  if (!db->open_dirs()) {
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+void lsm_close(void* h) {
+  Lsm* db = static_cast<Lsm*>(h);
+  db->close_all();
+  delete db;
+}
+
+int lsm_write_batch(void* h, const u8* payload, size_t len) {
+  return static_cast<Lsm*>(h)->write_batch(payload, len) ? 0 : -1;
+}
+
+int lsm_get(void* h, const u8* key, size_t klen, u8** val, size_t* vlen) {
+  std::string out;
+  int r = static_cast<Lsm*>(h)->get(std::string((const char*)key, klen), out);
+  if (r != 1) return r;
+  *val = (u8*)malloc(out.size() ? out.size() : 1);
+  memcpy(*val, out.data(), out.size());
+  *vlen = out.size();
+  return 1;
+}
+
+int lsm_scan_prefix(void* h, const u8* prefix, size_t plen, u8** buf,
+                    size_t* len) {
+  std::string out;
+  if (!static_cast<Lsm*>(h)->scan_prefix(
+          std::string((const char*)prefix, plen), out))
+    return -1;
+  *buf = (u8*)malloc(out.size() ? out.size() : 1);
+  memcpy(*buf, out.data(), out.size());
+  *len = out.size();
+  return 0;
+}
+
+int lsm_flush(void* h) {
+  Lsm* db = static_cast<Lsm*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->flush_memtable() ? 0 : -1;
+}
+
+void lsm_free(u8* p) { free(p); }
+
+// introspection for tests
+u64 lsm_table_count(void* h) {
+  return (u64) static_cast<Lsm*>(h)->tables.size();
+}
+
+int lsm_version() { return 1; }
+
+}  // extern "C"
