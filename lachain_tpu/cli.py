@@ -175,6 +175,7 @@ def _build_node(cfg, config_path=None):
         host=cfg.network.host,
         port=cfg.network.port,
         advertise_host=cfg.network.advertise_host,
+        relay=cfg.network.relay,
         initial_balances=balances,
         txs_per_block=cfg.blockchain.target_txs_per_block,
         wallet=wallet,

@@ -122,6 +122,9 @@ class NetworkSection:
     # the address OTHER nodes should dial (defaults to host; set when
     # binding a wildcard or behind NAT in multi-host deployments)
     advertise_host: Optional[str] = None
+    # "host:port:pubhex" of a public relay — NAT'd nodes with no dialable
+    # address participate through it (reference Hub relay bootstrap)
+    relay: Optional[str] = None
     # peers: list of "host:port:pubkeyhex"
     peers: List[str] = field(default_factory=list)
 
@@ -218,6 +221,7 @@ class NodeConfig:
                 host=net.get("host", "127.0.0.1"),
                 port=int(net.get("port", 7070)),
                 advertise_host=net.get("advertiseHost"),
+                relay=net.get("relay"),
                 peers=list(net.get("peers", [])),
             ),
             genesis=GenesisSection(

@@ -66,6 +66,7 @@ class Node:
         wallet: Optional[PrivateWallet] = None,
         block_interval: float = 0.0,
         advertise_host: Optional[str] = None,
+        relay: Optional[str] = None,  # "host:port:pubhex" — NAT'd mode
     ):
         self.index = index
         self.public_keys = public_keys
@@ -102,6 +103,7 @@ class Node:
             flush_interval=flush_interval,
             advertise_host=advertise_host,
         )
+        self._relay_spec = relay
         self.network.on_consensus = self._on_consensus
         self.network.on_sync_pool_reply = self._on_pool_txs
         self.network.on_ping_request = self._on_ping_request
@@ -190,6 +192,20 @@ class Node:
         FastSynchronizerBatch BEFORE blockSynchronizer.Start, so replay
         doesn't race the state download); call start_services() after."""
         await self.network.start()
+        if self._relay_spec:
+            # NAT'd mode (reference HubConnector bootstrap): register with
+            # the configured relay; our gossip address becomes the relay
+            # sentinel so peers route to us through it
+            from ..network.hub import PeerAddress as _PA
+
+            rhost, rport, rpub = self._relay_spec.rsplit(":", 2)
+            self.network.use_relay(
+                _PA(
+                    public_key=bytes.fromhex(rpub),
+                    host=rhost,
+                    port=int(rport),
+                )
+            )
         # the router exists before the era loop runs so consensus traffic
         # from faster peers is dispatched (or era-buffered), not dropped
         # (observers — index < 0 — only sync, never vote)

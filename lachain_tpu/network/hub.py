@@ -40,11 +40,17 @@ class Hub:
     ):
         self.host = host
         self.port = port
+        # called as on_batch(data) or, if the callable accepts it,
+        # on_batch(data, conn_id) — conn_id identifies the INBOUND
+        # connection the batch arrived on, for reverse delivery to peers
+        # that cannot be dialed (NAT'd relay clients)
         self.on_batch = on_batch
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: Dict[Tuple[str, int], asyncio.StreamWriter] = {}
         self._conn_locks: Dict[Tuple[str, int], asyncio.Lock] = {}
         self._reader_tasks: set = set()
+        self._inbound: Dict[int, asyncio.StreamWriter] = {}
+        self._next_conn_id = 1
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -68,29 +74,69 @@ class Hub:
         if self._server is not None:
             await self._server.wait_closed()
 
+    async def _read_frames(self, reader, conn_id) -> None:
+        """Shared frame loop for both directions (batches are
+        connection-agnostic; identity lives in the batch signature)."""
+        while True:
+            header = await reader.readexactly(4)
+            n = int.from_bytes(header, "big")
+            if n > MAX_FRAME:
+                raise ValueError("oversized frame")
+            data = await reader.readexactly(n)
+            try:
+                self.on_batch(data, conn_id)
+            except Exception:
+                logger.exception("batch handler failed")
+
     async def _handle_inbound(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         task = asyncio.current_task()
         if task is not None:
             self._reader_tasks.add(task)
+        conn_id = self._next_conn_id
+        self._next_conn_id += 1
+        self._inbound[conn_id] = writer
         try:
-            while True:
-                header = await reader.readexactly(4)
-                n = int.from_bytes(header, "big")
-                if n > MAX_FRAME:
-                    raise ValueError("oversized frame")
-                data = await reader.readexactly(n)
-                try:
-                    self.on_batch(data)
-                except Exception:
-                    logger.exception("batch handler failed")
+            await self._read_frames(reader, conn_id)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             pass
         finally:
+            self._inbound.pop(conn_id, None)
             writer.close()
             if task is not None:
                 self._reader_tasks.discard(task)
+
+    async def send_on_conn(self, conn_id: int, data: bytes) -> bool:
+        """Reverse delivery over a live INBOUND connection (the only path
+        to a NAT'd peer: it dialed us, we answer on its socket)."""
+        writer = self._inbound.get(conn_id)
+        if writer is None:
+            return False
+        try:
+            writer.write(len(data).to_bytes(4, "big") + data)
+            await writer.drain()
+            return True
+        except (ConnectionError, OSError):
+            self._inbound.pop(conn_id, None)
+            writer.close()
+            return False
+
+    async def _read_outbound(self, reader, key, my_writer) -> None:
+        """Outbound connections are READ too: a relay answers a NAT'd
+        node over the very connection the node dialed out (reverse
+        delivery) — frames arriving there are ordinary batches."""
+        try:
+            await self._read_frames(reader, None)
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            # close ONLY the connection this reader belongs to: a stale
+            # reader waking after a re-dial must not kill the replacement
+            my_writer.close()
+            if self._conns.get(key) is my_writer:
+                self._conns.pop(key, None)
 
     async def send_raw(self, peer: PeerAddress, data: bytes) -> bool:
         """Send one framed batch; dials on demand, drops the cached
@@ -102,10 +148,15 @@ class Hub:
             for attempt in (0, 1):
                 if writer is None:
                     try:
-                        _, writer = await asyncio.open_connection(
+                        reader, writer = await asyncio.open_connection(
                             peer.host, peer.port
                         )
                         self._conns[key] = writer
+                        t = asyncio.get_running_loop().create_task(
+                            self._read_outbound(reader, key, writer)
+                        )
+                        self._reader_tasks.add(t)
+                        t.add_done_callback(self._reader_tasks.discard)
                     except OSError:
                         return False
                 try:

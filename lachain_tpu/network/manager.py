@@ -62,6 +62,17 @@ class NetworkManager:
         # gossip peer discovery: fired when a previously-unknown peer is
         # learned from a peers_reply (after the worker already exists)
         self.on_peer_discovered: Optional[Callable[[PeerAddress], None]] = None
+        # --- relay / NAT traversal (reference Hub/HubConnector.cs) ---
+        # as a RELAY: registered NAT'd clients + the inbound connection
+        # each last spoke on (reverse-delivery path)
+        self.relay_clients: Dict[bytes, float] = {}   # pub -> last seen
+        self._last_conn: Dict[bytes, int] = {}        # pub -> conn id
+        self._relay_client_ttl = 90.0
+        # as a NAT'D NODE: the relay we registered with (None = direct)
+        self._my_relay: Optional[PeerAddress] = None
+        self._reregister_task = None
+        # as a SENDER: peers reachable only through a relay
+        self._relay_route: Dict[bytes, bytes] = {}    # peer pub -> relay pub
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -69,9 +80,57 @@ class NetworkManager:
         await self.hub.start()
 
     async def stop(self) -> None:
+        if self._reregister_task is not None:
+            self._reregister_task.cancel()
+            self._reregister_task = None
         for w in self._workers.values():
             await w.stop()
         await self.hub.stop()
+
+    # -- relay / NAT traversal ---------------------------------------------
+
+    def use_relay(self, relay: PeerAddress, reregister_every: float = 20.0) -> None:
+        """NAT'd mode: register with `relay` and advertise ourselves as
+        reachable through it. The registration re-sends periodically —
+        it refreshes the relay's TTL and keeps the NAT mapping warm."""
+        self._my_relay = relay
+        self.add_peer(relay, authoritative=True)
+        self.send_to(relay.public_key, wire.relay_register())
+
+        async def rereg():
+            while True:
+                await asyncio.sleep(reregister_every)
+                self.send_to(relay.public_key, wire.relay_register())
+
+        try:
+            self._reregister_task = asyncio.get_running_loop().create_task(
+                rereg()
+            )
+        except RuntimeError:
+            pass  # no loop (offline construction); caller re-registers
+
+    @property
+    def advertised_host_port(self):
+        """What we tell peers to reach us at: the relay sentinel when
+        NAT'd, the real listening address otherwise."""
+        if self._my_relay is not None:
+            return wire.relay_host(self._my_relay.public_key), 0
+        return self.advertise_host, self.hub.port
+
+    def _relay_transport(self, target_pub: bytes, relay_pub: bytes):
+        """ClientWorker transport for a relay-routed peer: wrap each signed
+        batch in a relay_forward and queue it to the RELAY's worker."""
+
+        async def send(_peer, batch_bytes: bytes) -> bool:
+            relay_worker = self._workers.get(relay_pub)
+            if relay_worker is None:
+                return False
+            relay_worker.enqueue(
+                wire.relay_forward(target_pub, batch_bytes)
+            )
+            return True
+
+        return send
 
     @property
     def address(self) -> PeerAddress:
@@ -88,9 +147,53 @@ class NetworkManager:
         (peers_reply entries) is non-authoritative: it can only introduce
         UNKNOWN peers, never rebind a known one, so a Byzantine address
         book cannot blackhole traffic to a validator we already reach.
+
+        A host of the form "~<relay pub hex>" (wire.relay_host) marks a
+        peer reachable only THROUGH that relay: its worker sends
+        relay_forward envelopes to the relay instead of dialing.
         """
         if peer.public_key == self.public_key:
             return
+        relay_pub = wire.parse_relay_host(peer.host)
+        if relay_pub is not None:
+            if relay_pub == self.public_key:
+                # we ARE this peer's relay: it reaches us inbound; traffic
+                # back to it rides its own connection (send_to fallback).
+                # It must be a registered client to be deliverable at all.
+                for msg in self._undelivered.pop(peer.public_key, ()):
+                    self.send_to(peer.public_key, msg)
+                return
+            if relay_pub not in self._workers:
+                logger.info(
+                    "peer %s advertises unknown relay %s; dropped",
+                    peer.public_key.hex()[:16], relay_pub.hex()[:16],
+                )
+                return
+            old_route = self._relay_route.get(peer.public_key)
+            if old_route == relay_pub and peer.public_key in self._workers:
+                return
+            if peer.public_key in self._workers and not authoritative and                     old_route is None:
+                return  # direct binding exists; gossip cannot demote it
+            self._relay_route[peer.public_key] = relay_pub
+            old = self._workers.pop(peer.public_key, None)
+            if old is not None:
+                try:
+                    asyncio.get_event_loop().create_task(old.stop())
+                except RuntimeError:
+                    pass
+            worker = ClientWorker(
+                peer, self.factory, self.hub,
+                flush_interval=self._flush_interval,
+                transport=self._relay_transport(peer.public_key, relay_pub),
+            )
+            self._workers[peer.public_key] = worker
+            worker.start()
+            host, port = self.advertised_host_port
+            worker.enqueue(wire.peers_request(host, port))
+            for msg in self._undelivered.pop(peer.public_key, ()):
+                worker.enqueue(msg)
+            return
+        self._relay_route.pop(peer.public_key, None)
         old = self._workers.get(peer.public_key)
         if old is not None:
             if not authoritative or (
@@ -119,9 +222,8 @@ class NetworkManager:
         # (config-seeded + gossip-learned peers; reference reaches peers
         # through bootstrap relays, HubConnector.cs:26-105 +
         # config_mainnet.json:22-33)
-        worker.enqueue(
-            wire.peers_request(self.advertise_host, self.hub.port)
-        )
+        adv_host, adv_port = self.advertised_host_port
+        worker.enqueue(wire.peers_request(adv_host, adv_port))
         for msg in self._undelivered.pop(peer.public_key, ()):
             worker.enqueue(msg)
 
@@ -134,6 +236,13 @@ class NetworkManager:
     def send_to(self, public_key: bytes, msg: NetworkMessage) -> None:
         worker = self._workers.get(public_key)
         if worker is None:
+            self._prune_relay_clients()
+            if public_key in self.relay_clients:
+                # OUR registered NAT'd client: answer over its own inbound
+                # connection (the only path that reaches it)
+                batch = self.factory.batch([msg])
+                self._send_inbound(public_key, batch.encode(), msg)
+                return
             pending = self._undelivered.setdefault(public_key, [])
             if len(pending) < self._undelivered_cap:
                 pending.append(msg)
@@ -145,13 +254,56 @@ class NetworkManager:
             return
         worker.enqueue(msg)
 
+    def _buffer_undelivered(self, public_key: bytes, msg) -> None:
+        pending = self._undelivered.setdefault(public_key, [])
+        if len(pending) < self._undelivered_cap:
+            pending.append(msg)
+
+    def _send_inbound(
+        self, public_key: bytes, data: bytes, msg=None
+    ) -> None:
+        """Reverse-deliver to a relay client. `msg` (when given) is
+        re-buffered on failure — consensus protocols do not retransmit,
+        so a message lost while the client re-dials would wedge an era
+        (same rationale as the _undelivered buffer for direct peers).
+        The buffer drains when the client's next batch arrives
+        (_on_raw_batch refreshes _last_conn and drains)."""
+        conn_id = self._last_conn.get(public_key)
+        if conn_id is None:
+            if msg is not None:
+                self._buffer_undelivered(public_key, msg)
+            return
+
+        async def deliver():
+            ok = await self.hub.send_on_conn(conn_id, data)
+            if not ok and msg is not None:
+                self._buffer_undelivered(public_key, msg)
+
+        try:
+            asyncio.get_event_loop().create_task(deliver())
+        except RuntimeError:
+            if msg is not None:
+                self._buffer_undelivered(public_key, msg)
+
+    def _prune_relay_clients(self) -> None:
+        import time
+
+        now = time.monotonic()
+        expired = [
+            p for p, t in self.relay_clients.items()
+            if now - t > self._relay_client_ttl
+        ]
+        for p in expired:
+            del self.relay_clients[p]
+            self._last_conn.pop(p, None)
+
     def broadcast(self, msg: NetworkMessage) -> None:
         for worker in self._workers.values():
             worker.enqueue(msg)
 
     # -- receiving ---------------------------------------------------------
 
-    def _on_raw_batch(self, data: bytes) -> None:
+    def _on_raw_batch(self, data: bytes, conn_id: Optional[int] = None) -> None:
         try:
             batch = MessageBatch.decode(data)
         except ValueError:
@@ -165,6 +317,15 @@ class NetworkManager:
         except (ValueError, zlib.error):
             logger.warning("corrupt batch content dropped")
             return
+        if conn_id is not None:
+            # remember the latest live inbound connection per verified
+            # sender: the reverse-delivery path to NAT'd relay clients.
+            # A reconnecting client also drains anything buffered while
+            # its connection was down.
+            self._last_conn[batch.sender] = conn_id
+            if batch.sender in self.relay_clients:
+                for m in self._undelivered.pop(batch.sender, ()):
+                    self.send_to(batch.sender, m)
         for msg in msgs:
             try:
                 self._dispatch(batch.sender, msg)
@@ -201,6 +362,48 @@ class NetworkManager:
             self._on_peers_request(sender, msg)
         elif k == wire.KIND_PEERS_REPLY:
             self._on_peers_reply(msg)
+        elif k == wire.KIND_RELAY_REGISTER:
+            self._on_relay_register(sender)
+        elif k == wire.KIND_RELAY_FORWARD:
+            self._on_relay_forward(sender, msg)
+
+    # -- relaying ----------------------------------------------------------
+
+    def _on_relay_register(self, sender: bytes) -> None:
+        import time
+
+        now = time.monotonic()
+        fresh = sender not in self.relay_clients
+        self.relay_clients[sender] = now
+        self._prune_relay_clients()
+        if fresh:
+            logger.info(
+                "relay client registered: %s", sender.hex()[:16]
+            )
+            # the client may have been buffered as undeliverable before
+            for m in self._undelivered.pop(sender, ()):
+                self.send_to(sender, m)
+
+    def _on_relay_forward(self, sender: bytes, msg: NetworkMessage) -> None:
+        try:
+            target, inner = wire.parse_relay_forward(msg)
+        except ValueError:
+            logger.warning("malformed relay_forward dropped")
+            return
+        if target == self.public_key:
+            # an envelope addressed to US (we are the NAT'd node and the
+            # relay delivered over our outbound conn): unwrap and process
+            # the inner batch — its own signature authenticates the origin
+            self._on_raw_batch(inner)
+            return
+        self._prune_relay_clients()
+        if target not in self.relay_clients:
+            logger.warning(
+                "relay_forward from %s for unregistered %s dropped",
+                sender.hex()[:16], target.hex()[:16],
+            )
+            return
+        self._send_inbound(target, inner)
 
     # -- gossip peer discovery ---------------------------------------------
 
@@ -218,7 +421,15 @@ class NetworkManager:
             for w in self._workers.values()
             if w.peer.public_key != sender
         ]
-        book.append((self.public_key, self.advertise_host, self.hub.port))
+        # our registered NAT'd clients are reachable THROUGH us (pruned
+        # first: a dead client must not be advertised into a void)
+        self._prune_relay_clients()
+        me = wire.relay_host(self.public_key)
+        for pub in self.relay_clients:
+            if pub != sender:
+                book.append((pub, me, 0))
+        adv_host, adv_port = self.advertised_host_port
+        book.append((self.public_key, adv_host, adv_port))
         self.send_to(sender, wire.peers_reply(book))
 
     def _on_peers_reply(self, msg: NetworkMessage) -> None:

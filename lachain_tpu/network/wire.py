@@ -161,6 +161,12 @@ KIND_TRIE_NODES_REQUEST = 10
 KIND_TRIE_NODES_REPLY = 11
 KIND_PEERS_REQUEST = 12
 KIND_PEERS_REPLY = 13
+# relay/NAT traversal (role of the reference's hub-relay network,
+# Hub/HubConnector.cs:26-105): a node with no dialable address registers
+# with a public relay and receives traffic wrapped in relay_forward
+# messages, delivered back over its own outbound TCP connection
+KIND_RELAY_REGISTER = 14
+KIND_RELAY_FORWARD = 15
 
 # reference NetworkMessagePriority: replies < consensus < pool sync
 PRIORITY = {
@@ -177,6 +183,8 @@ PRIORITY = {
     KIND_SYNC_POOL_REQUEST: 2,
     KIND_PEERS_REQUEST: 2,
     KIND_PEERS_REPLY: 2,
+    KIND_RELAY_REGISTER: 1,
+    KIND_RELAY_FORWARD: 1,  # carries consensus traffic: consensus priority
 }
 
 
@@ -397,6 +405,50 @@ def parse_peers_request(msg: NetworkMessage) -> Tuple[str, int]:
     port = r.u32()
     r.assert_eof()
     return host, port
+
+
+def relay_register() -> NetworkMessage:
+    """Sent by a NAT'd node to its relay: hold my registration and deliver
+    relay_forward traffic addressed to me over this connection. Re-sent
+    periodically (refreshes the TTL and keeps the NAT mapping alive)."""
+    return NetworkMessage(KIND_RELAY_REGISTER, b"")
+
+
+def relay_forward(target_pub: bytes, inner_batch: bytes) -> NetworkMessage:
+    """Wrap a SIGNED batch for `target_pub` to be delivered by the relay.
+    The inner batch carries the original sender's signature, so the relay
+    cannot forge or tamper — it only moves bytes."""
+    return NetworkMessage(
+        KIND_RELAY_FORWARD, write_bytes(target_pub) + write_bytes(inner_batch)
+    )
+
+
+def parse_relay_forward(msg: NetworkMessage) -> Tuple[bytes, bytes]:
+    r = Reader(msg.body)
+    target = r.bytes_()
+    inner = r.bytes_()
+    r.assert_eof()
+    return target, inner
+
+
+# host sentinel in peers books for a peer reachable only through a relay:
+# "~" + relay pubkey hex (port is ignored)
+RELAY_HOST_PREFIX = "~"
+
+
+def relay_host(relay_pub: bytes) -> str:
+    return RELAY_HOST_PREFIX + relay_pub.hex()
+
+
+def parse_relay_host(host: str):
+    """The relay pubkey from a sentinel host, or None for a normal host."""
+    if not host.startswith(RELAY_HOST_PREFIX):
+        return None
+    try:
+        pub = bytes.fromhex(host[1:])
+    except ValueError:
+        return None
+    return pub if len(pub) == 33 else None
 
 
 def peers_reply(peers: List[Tuple[bytes, str, int]]) -> NetworkMessage:

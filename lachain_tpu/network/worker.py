@@ -32,10 +32,19 @@ class ClientWorker:
         *,
         flush_interval: float = FLUSH_INTERVAL,
         max_batch_bytes: int = MAX_BATCH_BYTES,
+        transport=None,
     ):
         self.peer = peer
         self._factory = factory
         self._hub = hub
+        # transport(peer, batch_bytes) -> bool; default dials the peer
+        # directly. Relay-routed peers get a transport that wraps the
+        # signed batch in a relay_forward envelope instead (the envelope
+        # preserves end-to-end authentication — the inner batch carries
+        # OUR signature and only the target verifies it).
+        self._transport = transport or (
+            lambda p, data: self._hub.send_raw(p, data)
+        )
         self._flush_interval = flush_interval
         self._max_batch_bytes = max_batch_bytes
         # one FIFO deque per priority level (PRIORITY values are a small
@@ -107,7 +116,7 @@ class ClientWorker:
             while self._pending():
                 msgs = self._drain_batch()
                 batch: MessageBatch = self._factory.batch(msgs)
-                ok = await self._hub.send_raw(self.peer, batch.encode())
+                ok = await self._transport(self.peer, batch.encode())
                 if ok:
                     self._backoff = self._flush_interval
                     self.consecutive_failures = 0
@@ -128,4 +137,6 @@ class ClientWorker:
         # final flush on stop
         if self._pending():
             msgs = self._drain_batch()
-            await self._hub.send_raw(self.peer, self._factory.batch(msgs).encode())
+            await self._transport(
+                self.peer, self._factory.batch(msgs).encode()
+            )

@@ -103,6 +103,8 @@ class LsmKV(KVStore):
         r = self._lib.lsm_get(
             self._h, key, len(key), ctypes.byref(val), ctypes.byref(vlen)
         )
+        if r < 0:
+            raise IOError(f"LSM read failed for key {key!r}")
         if r != 1:
             return None
         try:

@@ -336,6 +336,16 @@ struct Lsm {
         apply_ops(ops);
         off += 8 + len;
       }
+      // discard the torn tail ON DISK too: appending new records after
+      // leftover garbage would make every future replay stop at the old
+      // torn record and silently drop the acknowledged batches behind it
+      if (off < buf.size()) {
+        int tfd = ::open(wal_path().c_str(), O_WRONLY);
+        if (tfd < 0) return false;
+        bool ok = ::ftruncate(tfd, (off_t)off) == 0 && ::fsync(tfd) == 0;
+        ::close(tfd);
+        if (!ok) return false;
+      }
     }
     wal_fd = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     return wal_fd >= 0;
@@ -417,7 +427,8 @@ struct Lsm {
     return true;
   }
 
-  // 1 found, 0 missing
+  // 1 found, 0 missing, -1 I/O error (a failed pread must NOT read as
+  // "key absent" — the state layer would proceed on wrong state)
   int get(const std::string& key, std::string& out) {
     std::lock_guard<std::mutex> g(mu);
     auto it = mem.find(key);
@@ -433,7 +444,7 @@ struct Lsm {
       out.assign(e->vlen, '\0');
       if (e->vlen &&
           ::pread(t->fd, &out[0], e->vlen, (off_t)e->off) != (ssize_t)e->vlen)
-        return 0;
+        return -1;
       return 1;
     }
     return 0;

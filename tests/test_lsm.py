@@ -197,3 +197,24 @@ def test_storage_engine_config_validation():
         == "lsm"
     )
     assert NodeConfig.from_dict({"version": 6}).storage_engine == "sqlite"
+
+
+def test_torn_wal_tail_truncated_on_open(tmp_path):
+    """Review finding: a torn WAL tail must be REMOVED from disk at open,
+    not just skipped — otherwise records appended after the garbage are
+    unreachable to every future replay (silent rollback of acked writes)."""
+    path = str(tmp_path / "db")
+    db = LsmKV(path)
+    db.put(b"a", b"1")
+    db.close()
+    # simulate a kill -9 torn tail: garbage bytes at the end of the WAL
+    with open(os.path.join(path, "wal.log"), "ab") as fh:
+        fh.write(b"\xde\xad\xbe\xef garbage torn record")
+    db = LsmKV(path)
+    assert db.get(b"a") == b"1"  # valid prefix replayed
+    db.put(b"b", b"2")           # appended after the (now truncated) tail
+    db.close()
+    db = LsmKV(path)             # replay must reach b
+    assert db.get(b"a") == b"1"
+    assert db.get(b"b") == b"2"
+    db.close()
