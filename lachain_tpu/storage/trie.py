@@ -32,6 +32,16 @@ def _nibble(h: bytes, depth: int) -> int:
     return (byte >> 4) if depth % 2 == 0 else (byte & 0x0F)
 
 
+def _group_by_nibble(pairs, depth: int) -> Dict[int, list]:
+    """Partition (kh, ...) pairs by their nibble at `depth` — the one
+    grouping rule both bulk paths share (canonical structure depends on
+    the two staying identical)."""
+    groups: Dict[int, list] = {}
+    for kh, v in pairs:
+        groups.setdefault(_nibble(kh, depth), []).append((kh, v))
+    return groups
+
+
 @dataclass(frozen=True)
 class LeafNode:
     key_hash: bytes  # full 32-byte hashed key
@@ -188,6 +198,79 @@ class Trie:
         new_root = self._del_hashed(root, kh, 0)
         return new_root if new_root is not None else root
 
+    def _collapse_or_store(self, children) -> bytes:
+        """Store an internal node, applying THE canonical collapse rule
+        (single shared copy: the bulk and sequential paths must collapse
+        identically or their roots diverge): an empty child set dissolves,
+        a single live LEAF child replaces the branch."""
+        live = [c for c in children if c != EMPTY_ROOT]
+        if not live:
+            return EMPTY_ROOT
+        if len(live) == 1:
+            only = self._load(live[0])
+            if isinstance(only, LeafNode):
+                return self._store(only)
+        return self._store(InternalNode(tuple(children)))
+
+    # -- bulk application ----------------------------------------------------
+    # The tree is CANONICAL in its leaf set (inserts create internal chains
+    # exactly along shared prefixes; deletes collapse single-leaf branches
+    # all the way back up), so applying a batch bottom-up produces the same
+    # root as replaying the keys one at a time — while rebuilding each
+    # shared internal node ONCE per block instead of once per key. This is
+    # the block-commit hot path: at N=64 the per-key replay was ~18% of the
+    # whole simulated era.
+
+    def apply_many(self, root: bytes, writes: Dict[bytes, Optional[bytes]]) -> bytes:
+        """Apply a {key: value-or-None(delete)} batch; returns the new root
+        (bit-identical to sequential put/delete in any order)."""
+        if not writes:
+            return root
+        entries: Dict[bytes, Optional[bytes]] = {
+            keccak256(k): v for k, v in writes.items()
+        }
+        ops = sorted(entries.items())
+        return self._bulk(root, ops, 0)
+
+    def _bulk(self, node_hash: bytes, ops, depth: int) -> bytes:
+        if not ops:
+            return node_hash
+        if node_hash == EMPTY_ROOT:
+            leaves = [(kh, v) for kh, v in ops if v is not None]
+            return self._build_subtree(leaves, depth)
+        node = self._load(node_hash)
+        if isinstance(node, LeafNode):
+            merged = dict(ops)
+            if node.key_hash not in merged:
+                merged[node.key_hash] = node.value
+            leaves = sorted(
+                (kh, v) for kh, v in merged.items() if v is not None
+            )
+            if leaves == [(node.key_hash, node.value)]:
+                return node_hash  # no-op batch over this leaf
+            return self._build_subtree(leaves, depth)
+        children = list(node.children)
+        groups = _group_by_nibble(ops, depth)
+        for nib, group in groups.items():
+            children[nib] = self._bulk(children[nib], group, depth + 1)
+        if children == list(node.children):
+            # nothing changed under us (absent-key deletes / same-value
+            # puts): a pure no-op, like sequential delete of a missing key
+            return node_hash
+        return self._collapse_or_store(children)
+
+    def _build_subtree(self, leaves, depth: int) -> bytes:
+        """Canonical subtree for sorted (kh, value) leaves on empty ground."""
+        if not leaves:
+            return EMPTY_ROOT
+        if len(leaves) == 1:
+            kh, v = leaves[0]
+            return self._store(LeafNode(kh, v))
+        children = [EMPTY_ROOT] * 16
+        for nib, group in _group_by_nibble(leaves, depth).items():
+            children[nib] = self._build_subtree(group, depth + 1)
+        return self._store(InternalNode(tuple(children)))
+
     def _del_hashed(self, node_hash: bytes, kh: bytes, depth: int) -> Optional[bytes]:
         """Returns the new subtree hash, EMPTY_ROOT if emptied, or None if
         the key was absent (no change)."""
@@ -202,14 +285,7 @@ class Trie:
             return None
         children = list(node.children)
         children[nib] = sub
-        live = [c for c in children if c != EMPTY_ROOT]
-        if not live:
-            return EMPTY_ROOT
-        if len(live) == 1:
-            only = self._load(live[0])
-            if isinstance(only, LeafNode):
-                return self._store(only)  # collapse single-leaf branch
-        return self._store(InternalNode(tuple(children)))
+        return self._collapse_or_store(children)
 
     def iter_items(self, root: bytes) -> Iterator[Tuple[bytes, bytes]]:
         """All (hashed_key, value) pairs under a root (ordered by key hash)."""

@@ -219,3 +219,52 @@ def test_db_shrink_resumes_after_crash_mid_mark():
     for h in range(15, 20):
         snap = state.new_snapshot(state.roots_at(h))
         assert snap.get("storage", f"k{h}:1".encode()) is not None
+
+
+def test_apply_many_matches_sequential_replay():
+    """Trie.apply_many must produce BIT-IDENTICAL roots to one-at-a-time
+    put/delete for arbitrary batches (puts, overwrites, deletes, deletes of
+    absent keys, full-subtree deletions) — the canonical-in-leaf-set
+    property the bulk path relies on."""
+    import random
+
+    from lachain_tpu.storage.kv import MemoryKV
+    from lachain_tpu.storage.trie import Trie
+
+    r = random.Random(1234)
+    t_seq = Trie(MemoryKV())
+    t_bulk = Trie(MemoryKV())
+    root_seq = root_bulk = b"\x00" * 32
+    live = set()
+    for round_no in range(30):
+        batch = {}
+        for _ in range(r.randrange(1, 40)):
+            if live and r.random() < 0.35:
+                k = r.choice(sorted(live))
+                if r.random() < 0.6:
+                    batch[k] = None  # delete existing
+                else:
+                    batch[k] = bytes(r.randrange(256) for _ in range(8))
+            elif r.random() < 0.1:
+                batch[f"absent{r.randrange(999)}".encode()] = None
+            else:
+                k = f"key{r.randrange(300)}".encode()
+                batch[k] = bytes(r.randrange(256) for _ in range(12))
+        for k, v in batch.items():
+            if v is None:
+                live.discard(k)
+            else:
+                live.add(k)
+        # sequential replay (any order — dict order here)
+        for k, v in sorted(batch.items()):
+            if v is None:
+                root_seq = t_seq.delete(root_seq, k)
+            else:
+                root_seq = t_seq.put(root_seq, k, v)
+        root_bulk = t_bulk.apply_many(root_bulk, batch)
+        assert root_seq == root_bulk, f"diverged at round {round_no}"
+    # wipe everything in one batch: must collapse to the empty root
+    root_bulk = t_bulk.apply_many(root_bulk, {k: None for k in live})
+    for k in sorted(live):
+        root_seq = t_seq.delete(root_seq, k)
+    assert root_seq == root_bulk == b"\x00" * 32
