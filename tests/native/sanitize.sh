@@ -17,6 +17,7 @@ echo "== building sanitized harnesses =="
 g++ $CXXFLAGS -o "$BUILD/check_msm" ../../benchmarks/native/check_msm.cpp
 g++ $CXXFLAGS -o "$BUILD/fuzz_decoders" fuzz_decoders.cpp
 g++ $CXXFLAGS -o "$BUILD/fuzz_consensus" fuzz_consensus.cpp
+g++ $CXXFLAGS -o "$BUILD/fuzz_lsm" fuzz_lsm.cpp
 
 echo "== differential (sanitized) =="
 "$BUILD/check_msm"
@@ -24,4 +25,6 @@ echo "== fuzz decoders (${FUZZ_SECONDS}s) =="
 "$BUILD/fuzz_decoders" "$FUZZ_SECONDS"
 echo "== fuzz consensus (${FUZZ_SECONDS}s) =="
 "$BUILD/fuzz_consensus" "$FUZZ_SECONDS"
+echo "== fuzz lsm corruption (${FUZZ_SECONDS}s) =="
+"$BUILD/fuzz_lsm" "$FUZZ_SECONDS"
 echo "SANITIZE GREEN"
