@@ -193,7 +193,21 @@ static bool write_table(const std::string& path,
   return true;
 }
 
+static bool load_table_inner(Table& t);
+
 static bool load_table(Table& t) {
+  // on ANY failure the fd must close here: the refusal path of open_dirs
+  // runs per attempted open (a corrupted store is retried by operators,
+  // and a long-lived process probing bad dirs must not leak fds)
+  if (!load_table_inner(t)) {
+    if (t.fd >= 0) ::close(t.fd);
+    t.fd = -1;
+    return false;
+  }
+  return true;
+}
+
+static bool load_table_inner(Table& t) {
   t.fd = ::open(t.path.c_str(), O_RDONLY);
   if (t.fd < 0) return false;
   off_t size = ::lseek(t.fd, 0, SEEK_END);
@@ -303,7 +317,11 @@ struct Lsm {
         t.path = dir + "/" + line;
         if (!load_table(t)) {
           fclose(mf);
-          return false;  // manifest names an unreadable table: refuse
+          // refuse — closing the tables already loaded (fd hygiene)
+          for (auto& prev : tables)
+            if (prev.fd >= 0) ::close(prev.fd);
+          tables.clear();
+          return false;
         }
         // track the highest sequence for next_seq
         unsigned long long seq = 0;
@@ -321,6 +339,9 @@ struct Lsm {
       if (size > 0) {
         if (::pread(rfd, buf.data(), (size_t)size, 0) != (ssize_t)size) {
           ::close(rfd);
+          for (auto& prev : tables)
+            if (prev.fd >= 0) ::close(prev.fd);
+          tables.clear();
           return false;
         }
       }
@@ -341,14 +362,25 @@ struct Lsm {
       // torn record and silently drop the acknowledged batches behind it
       if (off < buf.size()) {
         int tfd = ::open(wal_path().c_str(), O_WRONLY);
-        if (tfd < 0) return false;
-        bool ok = ::ftruncate(tfd, (off_t)off) == 0 && ::fsync(tfd) == 0;
-        ::close(tfd);
-        if (!ok) return false;
+        bool ok = tfd >= 0 && ::ftruncate(tfd, (off_t)off) == 0 &&
+                  ::fsync(tfd) == 0;
+        if (tfd >= 0) ::close(tfd);
+        if (!ok) {
+          for (auto& prev : tables)
+            if (prev.fd >= 0) ::close(prev.fd);
+          tables.clear();
+          return false;
+        }
       }
     }
     wal_fd = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
-    return wal_fd >= 0;
+    if (wal_fd < 0) {
+      for (auto& prev : tables)
+        if (prev.fd >= 0) ::close(prev.fd);
+      tables.clear();
+      return false;
+    }
+    return true;
   }
 
   bool flush_memtable() {

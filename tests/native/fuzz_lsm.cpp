@@ -86,12 +86,23 @@ int main(int argc, char** argv) {
       std::string k = "k" + std::to_string(rnd() % 64);
       std::string v(rnd() % 120, (char)('a' + (rnd() % 26)));
       std::string p = batch_one(k, v);
-      lsm_write_batch(h, (const u8*)p.data(), p.size());
+      // SURVIVOR CONTRACT: an opened engine accepts writes, and a key
+      // written THIS session reads back exactly (it lives in the
+      // memtable — damaged historical tables cannot shadow it)
+      if (lsm_write_batch(h, (const u8*)p.data(), p.size()) != 0) {
+        printf("FAIL: survivor refused write_batch\n");
+        return 1;
+      }
       if (rnd() % 8 == 0) {
         u8* val = nullptr;
         size_t vlen = 0;
         int r = lsm_get(h, (const u8*)k.data(), k.size(), &val, &vlen);
-        if (r == 1) lsm_free(val);
+        if (r != 1 || vlen != v.size() ||
+            memcmp(val, v.data(), vlen) != 0) {
+          printf("FAIL: survivor lost a just-written key (r=%d)\n", r);
+          return 1;
+        }
+        lsm_free(val);
       }
       if (rnd() % 16 == 0) {
         u8* buf = nullptr;
