@@ -172,8 +172,13 @@ class NetworkManager:
             old_route = self._relay_route.get(peer.public_key)
             if old_route == relay_pub and peer.public_key in self._workers:
                 return
-            if peer.public_key in self._workers and not authoritative and                     old_route is None:
-                return  # direct binding exists; gossip cannot demote it
+            if not authoritative and peer.public_key in self._workers:
+                # third-party gossip may only INTRODUCE unknown peers — it
+                # can neither demote a direct binding NOR move an existing
+                # relay route to a different relay (a Byzantine address
+                # book would blackhole the victim's traffic at a relay
+                # holding no registration for it)
+                return
             self._relay_route[peer.public_key] = relay_pub
             old = self._workers.pop(peer.public_key, None)
             if old is not None:
@@ -193,13 +198,20 @@ class NetworkManager:
             for msg in self._undelivered.pop(peer.public_key, ()):
                 worker.enqueue(msg)
             return
-        self._relay_route.pop(peer.public_key, None)
         old = self._workers.get(peer.public_key)
         if old is not None:
             if not authoritative or (
                 old.peer.host == peer.host and old.peer.port == peer.port
             ):
+                # REJECTED updates must not touch state: popping the relay
+                # route before this check let refused Byzantine gossip
+                # erase a relay-routed peer's entry (its next re-advert
+                # then tore down and recreated the worker, dropping its
+                # queued consensus messages)
                 return
+        # accepted direct binding: it supersedes any relay route
+        self._relay_route.pop(peer.public_key, None)
+        if old is not None:
             # self-declared address change: rebind
             logger.info(
                 "peer %s rebinds %s:%d -> %s:%d",

@@ -270,6 +270,14 @@ struct Lsm {
     return dir + buf;
   }
 
+  void close_tables() {
+    // single-sourced refusal/teardown contract: every open_dirs failure
+    // path and close_all release table fds through here
+    for (auto& t : tables)
+      if (t.fd >= 0) ::close(t.fd);
+    tables.clear();
+  }
+
   bool write_manifest() {
     std::string body;
     for (auto& t : tables) {
@@ -317,10 +325,7 @@ struct Lsm {
         t.path = dir + "/" + line;
         if (!load_table(t)) {
           fclose(mf);
-          // refuse — closing the tables already loaded (fd hygiene)
-          for (auto& prev : tables)
-            if (prev.fd >= 0) ::close(prev.fd);
-          tables.clear();
+          close_tables();  // refuse without leaking fds
           return false;
         }
         // track the highest sequence for next_seq
@@ -339,9 +344,7 @@ struct Lsm {
       if (size > 0) {
         if (::pread(rfd, buf.data(), (size_t)size, 0) != (ssize_t)size) {
           ::close(rfd);
-          for (auto& prev : tables)
-            if (prev.fd >= 0) ::close(prev.fd);
-          tables.clear();
+          close_tables();
           return false;
         }
       }
@@ -366,18 +369,14 @@ struct Lsm {
                   ::fsync(tfd) == 0;
         if (tfd >= 0) ::close(tfd);
         if (!ok) {
-          for (auto& prev : tables)
-            if (prev.fd >= 0) ::close(prev.fd);
-          tables.clear();
+          close_tables();
           return false;
         }
       }
     }
     wal_fd = ::open(wal_path().c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (wal_fd < 0) {
-      for (auto& prev : tables)
-        if (prev.fd >= 0) ::close(prev.fd);
-      tables.clear();
+      close_tables();
       return false;
     }
     return true;
@@ -527,9 +526,7 @@ struct Lsm {
     // durable by construction (WAL fsynced per batch); just release fds
     if (wal_fd >= 0) ::close(wal_fd);
     wal_fd = -1;
-    for (auto& t : tables)
-      if (t.fd >= 0) ::close(t.fd);
-    tables.clear();
+    close_tables();
   }
 };
 

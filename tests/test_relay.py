@@ -108,3 +108,74 @@ def test_natd_validator_participates_via_relay():
             await n.stop()
 
     asyncio.run(run())
+
+
+def test_gossip_cannot_rebind_existing_relay_route():
+    """Review finding pinned: third-party gossip may INTRODUCE a relayed
+    peer but never move an existing route to a different relay — a
+    Byzantine address book could otherwise blackhole a validator by
+    pointing its route at a relay that has no registration for it."""
+    from lachain_tpu.network.manager import NetworkManager
+
+    async def run():
+        mgr = NetworkManager(ecdsa.generate_private_key(Rng(1)))
+        await mgr.start()
+        relay1 = NetworkManager(ecdsa.generate_private_key(Rng(2)))
+        relay2 = NetworkManager(ecdsa.generate_private_key(Rng(3)))
+        await relay1.start()
+        await relay2.start()
+        victim_pub = ecdsa.public_key_bytes(
+            ecdsa.generate_private_key(Rng(4))
+        )
+        try:
+            mgr.add_peer(relay1.address)
+            mgr.add_peer(relay2.address)
+            # introduce the victim via relay1 (gossip CAN introduce)
+            mgr.add_peer(
+                PeerAddress(victim_pub, wire.relay_host(relay1.public_key), 0),
+                authoritative=False,
+            )
+            assert mgr._relay_route[victim_pub] == relay1.public_key
+            # Byzantine gossip tries to move the route to relay2: refused
+            mgr.add_peer(
+                PeerAddress(victim_pub, wire.relay_host(relay2.public_key), 0),
+                authoritative=False,
+            )
+            assert mgr._relay_route[victim_pub] == relay1.public_key
+            # ...and cannot demote a DIRECT binding to a relay route either
+            direct_pub = ecdsa.public_key_bytes(
+                ecdsa.generate_private_key(Rng(5))
+            )
+            mgr.add_peer(PeerAddress(direct_pub, "127.0.0.1", 12345))
+            mgr.add_peer(
+                PeerAddress(direct_pub, wire.relay_host(relay2.public_key), 0),
+                authoritative=False,
+            )
+            assert direct_pub not in mgr._relay_route
+            # an AUTHORITATIVE self-declaration may still move the route
+            mgr.add_peer(
+                PeerAddress(victim_pub, wire.relay_host(relay2.public_key), 0),
+                authoritative=True,
+            )
+            assert mgr._relay_route[victim_pub] == relay2.public_key
+            # unknown relays never create routes
+            ghost = ecdsa.public_key_bytes(ecdsa.generate_private_key(Rng(6)))
+            other = ecdsa.public_key_bytes(ecdsa.generate_private_key(Rng(7)))
+            mgr.add_peer(
+                PeerAddress(other, wire.relay_host(ghost), 0),
+                authoritative=False,
+            )
+            assert other not in mgr._relay_route
+            # REJECTED bogus DIRECT gossip must not erase the relay route
+            # (state mutations only after acceptance): victim stays routed
+            mgr.add_peer(
+                PeerAddress(victim_pub, "203.0.113.9", 4444),
+                authoritative=False,
+            )
+            assert mgr._relay_route[victim_pub] == relay2.public_key
+        finally:
+            await mgr.stop()
+            await relay1.stop()
+            await relay2.stop()
+
+    asyncio.run(run())
