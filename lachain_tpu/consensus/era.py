@@ -125,7 +125,23 @@ class EraRouter(Broadcaster):
         Eras never regress: a stale/duplicate call is a no-op."""
         if new_era <= self.era:
             return
+        old_era = self.era
         self.era = new_era
+        # drop protocol instances from finished eras (reference FinishEra
+        # clears its registry): laggard sub-protocols an era's outcome never
+        # needed would otherwise accumulate for the node's lifetime — real
+        # memory growth at N=64 scale and a stream of spurious watchdog
+        # stall reports. The LAST ACTIVE era is kept so late result_of
+        # queries (block production racing the advance, multi-era observer
+        # jumps included) still resolve.
+        cutoff = min(new_era - 1, old_era)
+        stale = [
+            pid
+            for pid in self._protocols
+            if getattr(pid, "era", new_era) < cutoff
+        ]
+        for pid in stale:
+            self._protocols.pop(pid, None)
         pending, self._postponed = self._postponed, []
         self._postponed_per_sender = {}
         for sender, payload in pending:
@@ -157,6 +173,12 @@ class EraRouter(Broadcaster):
         proto = self._protocols.get(pid)
         if proto is not None:
             return None if proto.terminated else proto
+        if getattr(pid, "era", self.era) < self.era:
+            # a dead era's instances are garbage-collected on advance, so
+            # their terminated tombstones are gone — a stale internal
+            # request must not resurrect a fresh never-terminating
+            # protocol whose broadcasts every peer discards
+            return None
         proto = self._create(pid)
         if proto is None:
             logger.warning("no factory for protocol id %s", pid)

@@ -246,3 +246,35 @@ def test_determinism_same_seed():
         )
         outs.append((net.delivered_count, net.results(pid)))
     assert outs[0] == outs[1]
+
+
+def test_advance_era_drops_stale_protocols():
+    """Protocol instances from finished eras must be dropped on advance
+    (reference FinishEra clears its registry): laggard sub-protocols
+    accumulated for the node's lifetime otherwise — unbounded memory and
+    spurious watchdog stall reports. The previous era is retained for
+    late result queries."""
+    from lachain_tpu.core.devnet import Devnet
+    import lachain_tpu.consensus.messages as M
+
+    dv = Devnet(n=4, f=1, chain_id=909, engine="python")
+    for era in (1, 2, 3):
+        dv.run_era(era)
+    router = dv.net.routers[0]
+    eras_alive = {getattr(pid, "era", None) for pid in router._protocols}
+    # eras 1 (and older) are gone; 2 (previous) and 3 (current) remain
+    assert 1 not in eras_alive, eras_alive
+    assert 3 in eras_alive
+    # the previous era's root result still resolves
+    assert router.result_of(M.RootProtocolId(era=2)) is not None
+    assert router.result_of(M.RootProtocolId(era=3)) is not None
+    # a stale internal request cannot resurrect a dead era's protocol
+    # (its tombstone was collected; a fresh one would never terminate)
+    router.internal_request(
+        M.Request(from_id=None, to_id=M.RootProtocolId(era=1), input=None)
+    )
+    assert M.RootProtocolId(era=1) not in router._protocols
+    # a MULTI-era jump (observer catching up) keeps the last ACTIVE era:
+    # cutoff follows the pre-advance era, not new_era - 1
+    router.advance_era(9)
+    assert router.result_of(M.RootProtocolId(era=3)) is not None
