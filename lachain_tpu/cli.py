@@ -401,6 +401,42 @@ def cmd_console(args) -> int:
             print("\ninterrupted", file=sys.stderr)
 
 
+def cmd_trace(args) -> int:
+    """Pull the node's era-lifecycle trace over RPC. Default output is
+    Chrome trace_event JSON — load it in chrome://tracing or Perfetto."""
+    import urllib.request
+
+    method = "la_getTraceSummary" if args.summary else "la_getTrace"
+    params = [] if args.summary or args.limit is None else [args.limit]
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        args.rpc, data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        print(f"error: {out['error'].get('message', out['error'])}",
+              file=sys.stderr)
+        return 1
+    result = out["result"]
+    if args.summary:
+        print(json.dumps(result, indent=2, sort_keys=True))
+        return 0
+    text = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+        print(
+            f"{len(result.get('traceEvents', []))} events -> {args.out} "
+            "(open in chrome://tracing or https://ui.perfetto.dev)"
+        )
+    else:
+        print(text)
+    return 0
+
+
 def cmd_run(args) -> int:
     from .core.config import NodeConfig
 
@@ -568,6 +604,23 @@ def main(argv=None) -> int:
         help="run ';'-separated commands non-interactively and exit",
     )
     co.set_defaults(fn=cmd_console)
+
+    tr = sub.add_parser(
+        "trace",
+        help="pull the node's era-lifecycle trace (Chrome trace_event JSON)",
+    )
+    tr.add_argument("--rpc", default="http://127.0.0.1:7071")
+    tr.add_argument("--timeout", type=float, default=10.0)
+    tr.add_argument("--out", help="write the trace JSON to this file")
+    tr.add_argument(
+        "--limit", type=int, default=None, help="cap the event count"
+    )
+    tr.add_argument(
+        "--summary",
+        action="store_true",
+        help="print the per-span aggregate instead of the full trace",
+    )
+    tr.set_defaults(fn=cmd_trace)
 
     de = sub.add_parser("decrypt", help="print a wallet's decrypted JSON")
     de.add_argument("--wallet", required=True)

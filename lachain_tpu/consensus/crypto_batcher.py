@@ -24,6 +24,17 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from ..utils import metrics, tracing
+
+# per-flush slot counts are small powers of two in practice; buckets track
+# the S_pad shapes the device kernel actually compiles
+_SLOT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+_WASTE_BUCKETS = (0.0, 0.1, 0.2, 0.3, 0.4, 0.5)
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
 
 class TpkeEraBatcher:
     """Collects (jobs, callback) submissions; flush() runs them in one call."""
@@ -84,6 +95,10 @@ class TpkeEraBatcher:
                 owners.append((si, ji))
                 key_of.append(vks)
         results: List = [None] * len(flat_jobs)
+        sid = tracing.begin(
+            "tpke.flush", cat="crypto", submissions=len(batch)
+        )
+        padded = 0
         try:
             off = 0
             while off < len(flat_jobs):
@@ -97,10 +112,12 @@ class TpkeEraBatcher:
                     and key_of[end] is vks
                 ):
                     end += 1
+                padded += _pow2_at_least(end - off)
                 out = era_fn(flat_jobs[off:end], vks)
                 results[off : off + len(out)] = out
                 off = end
         except Exception:
+            tracing.end(sid, outcome="exception")
             # device path broken mid-flush: liveness beats acceleration —
             # every submitter falls back to its per-slot host path
             import logging
@@ -111,6 +128,22 @@ class TpkeEraBatcher:
             for (_jobs, _vks, cb) in batch:
                 cb(None)
             return len(batch)
+        # the device kernel pads each chunk's slot axis to a power of two:
+        # pad-waste = fraction of padded lanes burnt on dummy slots —
+        # the number that tunes max_slots_per_call
+        waste = 1.0 - len(flat_jobs) / padded if padded else 0.0
+        tracing.end(
+            sid,
+            slots=len(flat_jobs),
+            slots_padded=padded,
+            pad_waste=round(waste, 4),
+        )
+        metrics.observe_hist(
+            "tpke_flush_slots", len(flat_jobs), buckets=_SLOT_BUCKETS
+        )
+        metrics.observe_hist(
+            "tpke_flush_pad_waste", waste, buckets=_WASTE_BUCKETS
+        )
         self.flushes += 1
         self.slots_flushed += len(flat_jobs)
         # regroup per submission and deliver

@@ -141,7 +141,12 @@ class EraRouter(Broadcaster):
             if getattr(pid, "era", new_era) < cutoff
         ]
         for pid in stale:
-            self._protocols.pop(pid, None)
+            proto = self._protocols.pop(pid, None)
+            if proto is not None:
+                # laggards the era's outcome never needed: close their
+                # lifetime spans so the trace doesn't report them as
+                # stuck-open forever
+                proto.close_span(outcome="era_gc")
         pending, self._postponed = self._postponed, []
         self._postponed_per_sender = {}
         for sender, payload in pending:

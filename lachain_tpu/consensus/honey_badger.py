@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..crypto import tpke
+from ..utils import tracing
 from . import messages as M
 from .keys import PrivateConsensusKeys, PublicConsensusKeys
 from .protocol import Broadcaster, Protocol
@@ -190,13 +191,22 @@ class HoneyBadger(Protocol):
             if not self._batcher_queued and self._ready_slots():
                 self._batcher_queued = True
                 batcher.submit_lazy(self._build_era_jobs_lazy)
+                tracing.instant(
+                    "hb.queue_decrypt", cat="crypto", era=self.id.era
+                )
             return
         built = self._build_era_jobs()
         if built is None:
             return
         jobs, vks, cb = built
         try:
-            results = era_fn(jobs, vks)
+            with tracing.span(
+                "hb.era_decrypt",
+                cat="crypto",
+                era=self.id.era,
+                slots=len(jobs),
+            ):
+                results = era_fn(jobs, vks)
         except Exception:
             # device path unavailable/broken (jax import, compile, OOM):
             # consensus liveness beats acceleration — host per-slot path
@@ -288,6 +298,15 @@ class HoneyBadger(Protocol):
         self._try_complete()
 
     def _apply_era_results(self, ready, results) -> None:
+        with tracing.span(
+            "hb.apply_era_results",
+            cat="crypto",
+            era=self.id.era,
+            slots=len(ready),
+        ):
+            self._apply_era_results_inner(ready, results)
+
+    def _apply_era_results_inner(self, ready, results) -> None:
         for slot, (ok, combined) in zip(ready, results):
             if ok:
                 self._plaintexts[slot] = tpke.decrypt_with_combined(

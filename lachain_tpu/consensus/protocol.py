@@ -73,9 +73,19 @@ class Protocol:
         # envelope would pin its payload for the protocol's lifetime)
         import time as _time
 
+        from ..utils import tracing
+
         self.started_at = _time.monotonic()
         self.last_activity = self.started_at
         self._last_kind: Optional[tuple] = None
+        # lifetime span: closed on emit_result / exception termination, or
+        # by the era GC sweep for instances an era's outcome never needed
+        self._span_id = tracing.begin(
+            type(self).__name__,
+            cat="protocol",
+            era=getattr(pid, "era", None),
+            pid=str(pid),
+        )
 
     # -- runtime ------------------------------------------------------------
     def receive(self, envelope) -> None:
@@ -117,6 +127,20 @@ class Protocol:
         except Exception:
             logger.exception("protocol %s terminated by exception", self.id)
             self.terminated = True
+            self.close_span(outcome="exception")
+
+    def close_span(self, outcome: str = "done") -> None:
+        """Close this instance's lifetime span (idempotent) and record its
+        duration in the per-protocol-type histogram."""
+        from ..utils import metrics, tracing
+
+        tracing.end(self._span_id, outcome=outcome)
+        if outcome == "done":
+            metrics.observe_hist(
+                "consensus_protocol_duration_seconds",
+                metrics.monotonic() - self.started_at,
+                labels={"protocol": type(self).__name__},
+            )
 
     def emit_result(self, value) -> None:
         """Report the protocol's output to the parent, once."""
@@ -124,6 +148,7 @@ class Protocol:
             return
         self._result_emitted = True
         self.result = value
+        self.close_span()
         self.broadcaster.internal_response(
             M.Result(from_id=self.id, to_id=self._parent, value=value)
         )

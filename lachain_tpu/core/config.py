@@ -102,6 +102,24 @@ def migrate(cfg: dict) -> dict:
             f"config version {version} is newer than supported "
             f"{CURRENT_VERSION}"
         )
+    if version == 5:
+        # a config SAVED at v5 belongs to a chain that ran round-4
+        # software, whose builds activated fast_wasm_gas from genesis.
+        # The v5->v6 migration default (the NEVER sentinel, correct for
+        # pre-round-4 configs) would silently DEACTIVATE the repricing on
+        # such a chain and fork it from peers on the next resync. There
+        # is no safe guess, so refuse until the operator states the
+        # height explicitly (DEPLOY.md "Upgrading v5 configs").
+        heights = (cfg.get("hardfork") or {}).get("heights") or {}
+        if "fast_wasm_gas" not in heights:
+            raise ValueError(
+                "refusing to migrate a version-5 config without an "
+                "explicit hardfork.heights.fast_wasm_gas: round-4 nodes "
+                "activated the repricing at genesis and the migration "
+                "default (never) would silently deactivate it. Set the "
+                "height this chain actually activated at (0 for round-4 "
+                "devnets) — see DEPLOY.md, 'Upgrading v5 configs'."
+            )
     while version < CURRENT_VERSION:
         step = _MIGRATIONS.get(version)
         if step is None:

@@ -247,13 +247,23 @@ class Node:
                     continue
                 stalled = now - proto.last_activity
                 if stalled > 60.0:
+                    from ..utils import tracing
+
                     logger.warning(
                         "protocol %s stalled for %.0fs (alive %.0fs, "
-                        "last message: %s)",
+                        "last message: %s, open spans: %s)",
                         pid,
                         stalled,
                         now - proto.started_at,
                         proto.last_message,
+                        tracing.open_stack_str(),
+                    )
+                    tracing.instant(
+                        "watchdog_stall",
+                        cat="watchdog",
+                        pid=str(pid),
+                        stalled_s=round(stalled, 1),
+                        last_message=proto.last_message,
                     )
                     proto.last_activity = now  # re-arm, don't spam
 
@@ -460,42 +470,54 @@ class Node:
         total; timeout=None (the autonomous loop) waits indefinitely —
         sync supersession is the recovery path there.
         """
+        from ..utils import tracing
+
         router = self._ensure_router(era)
         self._era_done.clear()
         pid = M.RootProtocolId(era=era)
-        router.internal_request(
-            M.Request(from_id=None, to_id=pid, input=None)
-        )
-        self._check_era_done()
-        loop = asyncio.get_running_loop()
-        deadline = None if timeout is None else loop.time() + timeout
-        while router.result_of(pid) is None:
-            if self._stopping:
-                raise asyncio.CancelledError(f"node stopped during era {era}")
-            if self.block_manager.current_height() >= era:
-                block = self.block_manager.block_by_height(era)
-                assert block is not None
-                return block
-            remaining = None
-            if deadline is not None:
-                remaining = deadline - loop.time()
-                if remaining <= 0:
-                    raise TimeoutError(f"era {era} stalled")
-            self._era_done.clear()
-            self._height_event.clear()
-            done = asyncio.ensure_future(self._era_done.wait())
-            height = asyncio.ensure_future(self._height_event.wait())
-            try:
-                await asyncio.wait(
-                    [done, height],
-                    timeout=remaining,
-                    return_when=asyncio.FIRST_COMPLETED,
-                )
-            finally:
-                for fut in (done, height):
-                    fut.cancel()
-        block = router.result_of(pid)
-        return block
+        sid = tracing.begin("era", era=era)
+        outcome = "aborted"
+        try:
+            router.internal_request(
+                M.Request(from_id=None, to_id=pid, input=None)
+            )
+            self._check_era_done()
+            loop = asyncio.get_running_loop()
+            deadline = None if timeout is None else loop.time() + timeout
+            while router.result_of(pid) is None:
+                if self._stopping:
+                    raise asyncio.CancelledError(
+                        f"node stopped during era {era}"
+                    )
+                if self.block_manager.current_height() >= era:
+                    block = self.block_manager.block_by_height(era)
+                    assert block is not None
+                    outcome = "synced"
+                    return block
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        outcome = "timeout"
+                        raise TimeoutError(f"era {era} stalled")
+                self._era_done.clear()
+                self._height_event.clear()
+                done = asyncio.ensure_future(self._era_done.wait())
+                height = asyncio.ensure_future(self._height_event.wait())
+                try:
+                    await asyncio.wait(
+                        [done, height],
+                        timeout=remaining,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                finally:
+                    for fut in (done, height):
+                        fut.cancel()
+            block = router.result_of(pid)
+            outcome = "consensus"
+            return block
+        finally:
+            tracing.end(sid, outcome=outcome)
 
     async def run_eras(self, first: int, count: int) -> List[Block]:
         return [await self.run_era(first + i) for i in range(count)]
@@ -534,6 +556,11 @@ class Node:
         )
 
     def _on_block_persisted(self, block: Block) -> None:
+        from ..utils import tracing
+
+        tracing.instant(
+            "block_persisted", cat="block", height=block.header.index
+        )
         snap = self.state.new_snapshot()
         self.validator_status.on_block_persisted(block, snap)
         self.keygen_manager.on_block_persisted(block, snap)
@@ -660,19 +687,27 @@ class Node:
             else:
                 self._rebuild_router(era)
                 await self.run_era(era, timeout=None)
-            self._finish_era_metrics(era)
+            self._finish_era_metrics(era, loop.time() - era_start)
             if self.block_interval > 0:
                 remaining = self.block_interval - (loop.time() - era_start)
                 if remaining > 0 and not self._stopping:
                     await asyncio.sleep(remaining)
             era += 1
 
-    def _finish_era_metrics(self, era: int) -> None:
+    def _finish_era_metrics(
+        self, era: int, wall_seconds: Optional[float] = None
+    ) -> None:
         """Per-era crypto counter dump + reset (reference FinishEra ->
         DefaultCrypto.ResetBenchmark, ConsensusManager.cs:178,
         DefaultCrypto.cs:47-69)."""
         from ..utils import metrics
 
+        if wall_seconds is not None:
+            metrics.observe_hist(
+                "era_wall_seconds",
+                wall_seconds,
+                buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+            )
         snap = metrics.timer_snapshot(reset=True, reset_prefix="crypto_")
         crypto = {k: v for k, v in snap.items() if k.startswith("crypto_")}
         if crypto:

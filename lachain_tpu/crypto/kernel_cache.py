@@ -32,6 +32,8 @@ import pickle
 import threading
 from typing import Any, Dict, Tuple
 
+from ..utils import metrics
+
 logger = logging.getLogger("lachain.kernel_cache")
 
 _memo: Dict[str, Any] = {}
@@ -148,6 +150,7 @@ def call(jit_fn, name: str, *args, **statics):
     `args` must all be arrays (shapes form the cache key); `statics` are
     the jit's static kwargs."""
     if not _single_device():
+        metrics.inc("kernel_cache_requests", labels={"tier": "bypass"})
         return jit_fn(*args, **statics)
     key = _key(name, args, statics)
     compiled = _memo.get(key)
@@ -157,11 +160,30 @@ def call(jit_fn, name: str, *args, **statics):
             if compiled is None:
                 compiled = _disk_load(key)
                 if compiled is None:
+                    metrics.inc(
+                        "kernel_cache_requests", labels={"tier": "compile"}
+                    )
+                    t0 = metrics.monotonic()
                     lowered = jit_fn.lower(*args, **statics)
                     compiled = lowered.compile()
+                    metrics.observe_hist(
+                        "kernel_cache_compile_seconds",
+                        metrics.monotonic() - t0,
+                        buckets=(0.1, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0),
+                    )
                     _disk_store(key, compiled)
+                else:
+                    metrics.inc(
+                        "kernel_cache_requests", labels={"tier": "disk"}
+                    )
                 with _lock:
                     _memo[key] = compiled
+            else:
+                metrics.inc(
+                    "kernel_cache_requests", labels={"tier": "memo"}
+                )
+    else:
+        metrics.inc("kernel_cache_requests", labels={"tier": "memo"})
     return compiled(*args)
 
 
@@ -170,18 +192,24 @@ def warm(jit_fn, name: str, *args, **statics) -> bool:
     WITHOUT running it. Returns True if it came from disk."""
     if not _single_device():
         jit_fn.lower(*args, **statics).compile()  # jax's in-process cache
+        metrics.inc("kernel_cache_warm", labels={"tier": "bypass"})
         return False
     key = _key(name, args, statics)
     if key in _memo:
+        metrics.inc("kernel_cache_warm", labels={"tier": "memo"})
         return True
     with _lock_for(key):
         if key in _memo:
+            metrics.inc("kernel_cache_warm", labels={"tier": "memo"})
             return True
         compiled = _disk_load(key)
         from_disk = compiled is not None
         if compiled is None:
+            metrics.inc("kernel_cache_warm", labels={"tier": "compile"})
             compiled = jit_fn.lower(*args, **statics).compile()
             _disk_store(key, compiled)
+        else:
+            metrics.inc("kernel_cache_warm", labels={"tier": "disk"})
         with _lock:
             _memo[key] = compiled
     return from_disk

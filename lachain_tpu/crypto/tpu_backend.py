@@ -225,6 +225,7 @@ class TpuBackend:
         from ..ops import pg1, pg2
         from ..ops.verify import _pow2_at_least
 
+        t0 = metrics.monotonic()
         n = len(points)
         n_pad = _pow2_at_least(n)
         inf = bls.G2_INF if g2 else bls.G1_INF
@@ -247,6 +248,11 @@ class TpuBackend:
             )
             out = pg1.g1_unpack(fused[:132], fused[132] != 0)
         metrics.inc("crypto_tpu_device_msm_calls")
+        metrics.observe_hist(
+            "crypto_tpu_device_msm_seconds",
+            metrics.monotonic() - t0,
+            labels={"group": "g2" if g2 else "g1"},
+        )
         self.device_msm_calls += 1
         return out[0]
 
@@ -349,15 +355,39 @@ class TpuBackend:
             slots.append(
                 ([p if p is not None else inf_point for p in row], list(lag))
             )
-        for _ in range(_pow2_at_least(s) - s):
+        s_pad = _pow2_at_least(s)
+        for _ in range(s_pad - s):
             slots.append(([inf_point] * k, [0] * k))
             masks.append([False] * k)
-        lanes = _pow2_at_least(s) * _pow2_at_least(k)
+        lanes = s_pad * _pow2_at_least(k)
         if lanes >= self.min_device_lanes:
             pipeline = pipeline_getter()
+            path = "device"
         else:
             pipeline = host_pipeline_getter()
+            path = "host"
+        # pad-waste: fraction of the padded slot axis burnt on fully-masked
+        # dummy slots — the number that explains bench variance and tunes
+        # the batcher's max_slots_per_call
+        metrics.inc("crypto_tpu_era_route", labels={"path": path})
+        metrics.inc("crypto_tpu_era_slots_padded", s_pad - s)
+        metrics.observe_hist(
+            "crypto_tpu_era_batch_slots",
+            s,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        metrics.observe_hist(
+            "crypto_tpu_era_pad_waste",
+            1.0 - s / s_pad,
+            buckets=(0.0, 0.1, 0.2, 0.3, 0.4, 0.5),
+        )
+        t0 = metrics.monotonic()
         aggs, _rlc = pipeline.run_era(slots, y_points, rng, masks=masks)
+        metrics.observe_hist(
+            "crypto_tpu_era_pipeline_seconds",
+            metrics.monotonic() - t0,
+            labels={"path": path},
+        )
 
         def group_ok(idx: List[int]) -> bool:
             pairs = []

@@ -13,13 +13,42 @@ Framing: 4-byte big-endian length + raw batch bytes.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
+from ..utils import metrics
+
 logger = logging.getLogger(__name__)
 
 MAX_FRAME = 1 << 26  # 64 MiB
+
+# inbound frame sizes (bytes): worker batches cap at 64 KiB, sync replies
+# and fast-sync chunks run far larger
+_FRAME_BUCKETS = (
+    64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 8388608,
+)
+
+
+def _accepts_conn_id(cb: Callable) -> bool:
+    """True when `cb` can take the (data, conn_id) pair. Decided ONCE at
+    construction — a per-frame try/except TypeError would also swallow
+    genuine TypeErrors raised inside the handler."""
+    try:
+        sig = inspect.signature(cb)
+    except (TypeError, ValueError):
+        return True  # uninspectable (C callable): assume the full contract
+    n_positional = 0
+    for p in sig.parameters.values():
+        if p.kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ):
+            n_positional += 1
+        elif p.kind is inspect.Parameter.VAR_POSITIONAL:
+            return True
+    return n_positional >= 2
 
 
 @dataclass(frozen=True)
@@ -36,15 +65,18 @@ class Hub:
         self,
         host: str,
         port: int,
-        on_batch: Callable[[bytes], None],
+        on_batch: Callable[..., None],
     ):
         self.host = host
         self.port = port
-        # called as on_batch(data) or, if the callable accepts it,
-        # on_batch(data, conn_id) — conn_id identifies the INBOUND
-        # connection the batch arrived on, for reverse delivery to peers
-        # that cannot be dialed (NAT'd relay clients)
+        # called as on_batch(data, conn_id) when the callable accepts two
+        # positional args, else on_batch(data) — conn_id identifies the
+        # INBOUND connection the batch arrived on, for reverse delivery to
+        # peers that cannot be dialed (NAT'd relay clients). Arity is
+        # resolved once here so a 1-arg handler receives traffic instead
+        # of raising TypeError on every frame.
         self.on_batch = on_batch
+        self._pass_conn_id = _accepts_conn_id(on_batch)
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: Dict[Tuple[str, int], asyncio.StreamWriter] = {}
         self._conn_locks: Dict[Tuple[str, int], asyncio.Lock] = {}
@@ -83,8 +115,14 @@ class Hub:
             if n > MAX_FRAME:
                 raise ValueError("oversized frame")
             data = await reader.readexactly(n)
+            metrics.observe_hist(
+                "network_frame_bytes", n, buckets=_FRAME_BUCKETS
+            )
             try:
-                self.on_batch(data, conn_id)
+                if self._pass_conn_id:
+                    self.on_batch(data, conn_id)
+                else:
+                    self.on_batch(data)
             except Exception:
                 logger.exception("batch handler failed")
 

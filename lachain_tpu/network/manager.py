@@ -183,9 +183,15 @@ class NetworkManager:
             old = self._workers.pop(peer.public_key, None)
             if old is not None:
                 try:
-                    asyncio.get_event_loop().create_task(old.stop())
+                    asyncio.get_running_loop().create_task(old.stop())
                 except RuntimeError:
-                    pass
+                    # no running loop (offline construction/tests): the
+                    # worker's tasks were never started, nothing to stop
+                    logger.debug(
+                        "no running loop; old relay worker for %s dropped "
+                        "without async stop",
+                        peer.public_key.hex()[:16],
+                    )
             worker = ClientWorker(
                 peer, self.factory, self.hub,
                 flush_interval=self._flush_interval,
@@ -220,9 +226,13 @@ class NetworkManager:
             )
             self._workers.pop(peer.public_key, None)
             try:
-                asyncio.get_event_loop().create_task(old.stop())
+                asyncio.get_running_loop().create_task(old.stop())
             except RuntimeError:  # no running loop (tests)
-                pass
+                logger.debug(
+                    "no running loop; rebound worker for %s dropped "
+                    "without async stop",
+                    peer.public_key.hex()[:16],
+                )
         worker = ClientWorker(
             peer, self.factory, self.hub,
             flush_interval=self._flush_interval,
@@ -292,8 +302,14 @@ class NetworkManager:
                 self._buffer_undelivered(public_key, msg)
 
         try:
-            asyncio.get_event_loop().create_task(deliver())
+            asyncio.get_running_loop().create_task(deliver())
         except RuntimeError:
+            # no running loop: reverse delivery needs the hub's socket,
+            # so the message can only wait for the client's next contact
+            logger.debug(
+                "no running loop; reverse delivery to %s buffered",
+                public_key.hex()[:16],
+            )
             if msg is not None:
                 self._buffer_undelivered(public_key, msg)
 

@@ -320,6 +320,12 @@ class JsonRpcServer:
                     h.get("signature", ""), h.get("timestamp", ""),
                 ):
                     return _err(rid, -32000, "unauthorized private method")
+        from ..utils import metrics
+
+        # labeled per-method latency histogram; only REGISTERED methods
+        # get a series (an attacker probing random names must not be able
+        # to grow the label set without bound)
+        t0 = metrics.monotonic()
         try:
             if isinstance(params, dict):
                 result = fn(**params)
@@ -334,6 +340,12 @@ class JsonRpcServer:
         except Exception as e:
             logger.exception("rpc method %s failed", method)
             return _err(rid, -32603, f"internal error: {e}")
+        finally:
+            metrics.observe_hist(
+                "rpc_request_seconds",
+                metrics.monotonic() - t0,
+                labels={"method": method},
+            )
         if rid is None:
             return None  # notification
         return {"jsonrpc": "2.0", "id": rid, "result": result}

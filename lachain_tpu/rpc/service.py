@@ -563,6 +563,23 @@ class RpcService:
             "timers": metrics.timer_snapshot(reset=False),
         }
 
+    def la_getTrace(self, limit=None):
+        """Era-lifecycle trace as Chrome trace_event JSON (load in
+        chrome://tracing / Perfetto): era -> sub-protocol -> TPKE flush ->
+        block persist spans, from the in-process ring buffer. `limit`
+        caps the event count (newest first)."""
+        from ..utils import tracing
+
+        n = int(limit, 16) if isinstance(limit, str) else limit
+        return tracing.to_chrome_trace(limit=n)
+
+    def la_getTraceSummary(self):
+        """Per-span-name aggregate of the trace ring buffer:
+        {name: {count, total_ms, max_ms, open}}."""
+        from ..utils import tracing
+
+        return tracing.summary()
+
     def validator_status(self):
         vsm = self.node.validator_status
         return {

@@ -587,7 +587,11 @@ void lsm_free(u8* p) { free(p); }
 
 // introspection for tests
 u64 lsm_table_count(void* h) {
-  return (u64) static_cast<Lsm*>(h)->tables.size();
+  // tables is mutated by flush/compaction under mu; an unguarded size()
+  // read races a concurrent push_back/erase (UB on libstdc++ vectors)
+  Lsm* db = static_cast<Lsm*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return (u64) db->tables.size();
 }
 
 int lsm_version() { return 1; }

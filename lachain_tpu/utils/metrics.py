@@ -16,17 +16,21 @@ crypto layer and RPC agree on a single view.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 _lock = threading.Lock()
 # name -> (count, total_seconds)
 _timers: Dict[str, Tuple[int, float]] = {}
-# name -> value
-_counters: Dict[str, float] = {}
-_gauges: Dict[str, float] = {}
+# (name, labels) -> value; labels is a sorted tuple of (key, value) pairs,
+# () for unlabeled series (the common case; keeps the old flat registry)
+_counters: Dict[Tuple[str, tuple], float] = {}
+_gauges: Dict[Tuple[str, tuple], float] = {}
+# (name, labels) -> Histogram
+_histograms: Dict[Tuple[str, tuple], "Histogram"] = {}
 
 # hot-path cell for the per-consensus-message counter: `inc()` takes the
 # registry lock per call, which is real overhead at 2M-message eras (N=64
@@ -34,6 +38,112 @@ _gauges: Dict[str, float] = {}
 # folds it into the `consensus_messages_processed` counter on exposition.
 MESSAGES_PROCESSED = [0]
 monotonic = time.monotonic
+
+# Prometheus-ish default latency buckets (seconds): sub-ms crypto ops up to
+# multi-second era walls
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: Optional[dict]) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(pairs: Sequence[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in pairs
+    )
+    return "{" + body + "}"
+
+
+def _fmt_num(v: float) -> str:
+    # "1" not "1.0" for bucket bounds; plain repr for everything else
+    return "%g" % v
+
+
+class Histogram:
+    """Prometheus histogram with GIL-atomic hot-path cells.
+
+    `observe()` is the MESSAGES_PROCESSED idiom generalized: bucket counts
+    and the sum/count live in bare list cells whose `+=` is atomic enough
+    under the GIL, so per-frame / per-message call sites never contend on
+    the registry lock. A scrape may read sum and count a hair apart —
+    the standard trade for lock-free observation."""
+
+    __slots__ = ("name", "labels", "buckets", "_counts", "_sum", "_count")
+
+    def __init__(
+        self,
+        name: str,
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        labels: tuple = (),
+    ):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # one cell per finite bucket + the +Inf overflow cell
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = [0.0]
+        self._count = [0]
+
+    def observe(self, value: float) -> None:
+        # le is "less than or equal": first bucket whose bound >= value
+        self._counts[bisect.bisect_left(self.buckets, value)] += 1
+        self._sum[0] += value
+        self._count[0] += 1
+
+    def snapshot(self) -> dict:
+        """{count, sum, buckets: [(le, cumulative), ...]} — cumulative as
+        the exposition renders them."""
+        cum = 0
+        out = []
+        for bound, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append((bound, cum))
+        return {
+            "count": self._count[0],
+            "sum": self._sum[0],
+            "buckets": out,
+        }
+
+
+def histogram(
+    name: str,
+    buckets: Sequence[float] = DEFAULT_BUCKETS,
+    labels: Optional[dict] = None,
+) -> Histogram:
+    """Get-or-create the histogram for (name, labels). Hold the returned
+    object on hot paths — `observe()` never takes the registry lock."""
+    key = (name, _label_key(labels))
+    h = _histograms.get(key)
+    if h is None:
+        with _lock:
+            h = _histograms.get(key)
+            if h is None:
+                h = Histogram(name, buckets, key[1])
+                _histograms[key] = h
+    return h
+
+
+def observe_hist(
+    name: str,
+    value: float,
+    buckets: Sequence[float] = DEFAULT_BUCKETS,
+    labels: Optional[dict] = None,
+) -> None:
+    """Convenience one-shot observation for warm (non-hot) paths."""
+    histogram(name, buckets, labels).observe(value)
+
+
+def histogram_snapshot(name: str, labels: Optional[dict] = None):
+    h = _histograms.get((name, _label_key(labels)))
+    return h.snapshot() if h is not None else None
 
 
 @contextmanager
@@ -65,19 +175,24 @@ def timed(name: str):
     return deco
 
 
-def inc(name: str, amount: float = 1.0) -> None:
+def inc(
+    name: str, amount: float = 1.0, labels: Optional[dict] = None
+) -> None:
+    key = (name, _label_key(labels))
     with _lock:
-        _counters[name] = _counters.get(name, 0.0) + amount
+        _counters[key] = _counters.get(key, 0.0) + amount
 
 
-def set_gauge(name: str, value: float) -> None:
+def set_gauge(
+    name: str, value: float, labels: Optional[dict] = None
+) -> None:
     with _lock:
-        _gauges[name] = value
+        _gauges[(name, _label_key(labels))] = value
 
 
-def counter_value(name: str) -> float:
+def counter_value(name: str, labels: Optional[dict] = None) -> float:
     with _lock:
-        return _counters.get(name, 0.0)
+        return _counters.get((name, _label_key(labels)), 0.0)
 
 
 def observe(name: str, seconds: float) -> None:
@@ -112,25 +227,45 @@ def timer_snapshot(
 
 
 def render_text() -> str:
-    """Prometheus text exposition of counters, gauges and timers."""
+    """Prometheus text exposition of counters, gauges, timers and
+    histograms (labeled series grouped under one # TYPE header)."""
     lines = []
     with _lock:
         if MESSAGES_PROCESSED[0]:
-            base = _counters.get("consensus_messages_processed", 0.0)
-            _counters["consensus_messages_processed"] = (
-                base + MESSAGES_PROCESSED[0]
-            )
+            key = ("consensus_messages_processed", ())
+            _counters[key] = _counters.get(key, 0.0) + MESSAGES_PROCESSED[0]
             MESSAGES_PROCESSED[0] = 0
-        for name, v in sorted(_counters.items()):
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {v}")
-        for name, v in sorted(_gauges.items()):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {v}")
+        last = None
+        for (name, labels), v in sorted(_counters.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} counter")
+                last = name
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
+        last = None
+        for (name, labels), v in sorted(_gauges.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} gauge")
+                last = name
+            lines.append(f"{name}{_fmt_labels(labels)} {v}")
         for name, (cnt, total) in sorted(_timers.items()):
             lines.append(f"# TYPE {name}_seconds summary")
             lines.append(f"{name}_seconds_count {cnt}")
             lines.append(f"{name}_seconds_sum {total}")
+        last = None
+        for (name, labels), h in sorted(_histograms.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} histogram")
+                last = name
+            snap = h.snapshot()
+            for bound, cum in snap["buckets"]:
+                le = list(labels) + [("le", _fmt_num(bound))]
+                lines.append(f"{name}_bucket{_fmt_labels(le)} {cum}")
+            inf = list(labels) + [("le", "+Inf")]
+            lines.append(f"{name}_bucket{_fmt_labels(inf)} {snap['count']}")
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {snap['sum']}")
+            lines.append(
+                f"{name}_count{_fmt_labels(labels)} {snap['count']}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -139,4 +274,5 @@ def reset_all_for_tests() -> None:
         _timers.clear()
         _counters.clear()
         _gauges.clear()
+        _histograms.clear()
         MESSAGES_PROCESSED[0] = 0

@@ -1,0 +1,206 @@
+"""Era-lifecycle span recorder: where inside an era does the time go?
+
+The metrics registry answers "how much / how often"; this module answers
+"WHEN, nested under WHAT": era start -> sub-protocol lifetimes (RBC/BA/CC/
+ACS/HB) -> TPKE flush -> block persist. Spans are recorded into a bounded
+in-process ring buffer (zero dependencies, thread-safe) and exported as
+Chrome `trace_event` JSON — load the output of `lachain-tpu trace` (RPC
+`la_getTrace`) straight into chrome://tracing or Perfetto.
+
+Protocol lifetimes are NOT stack-shaped (dozens overlap within one era), so
+the primitive is a begin()/end() handle pair rather than only a context
+manager; `span()` wraps the common scoped case. The 60 s stall watchdog
+attaches `open_stack_str()` to its report so a stall names the exact
+protocol (and flush/persist phase) it is stuck inside.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_ids = itertools.count(1)
+# finished spans, oldest evicted first; 8192 spans ≈ a few dozen eras at
+# N=16 — enough history to explain a stall without unbounded growth
+DEFAULT_CAPACITY = 8192
+_done: deque = deque(maxlen=DEFAULT_CAPACITY)
+_open: "Dict[int, _Span]" = {}
+# monotonic epoch so exported timestamps are small positive microseconds
+_epoch = time.monotonic()
+
+
+class _Span:
+    __slots__ = ("sid", "name", "cat", "start", "end", "args")
+
+    def __init__(self, sid: int, name: str, cat: str, start: float, args):
+        self.sid = sid
+        self.name = name
+        self.cat = cat
+        self.start = start
+        self.end: Optional[float] = None
+        self.args: Dict[str, Any] = args
+
+    def to_dict(self, now: Optional[float] = None) -> dict:
+        end = self.end if self.end is not None else now
+        return {
+            "id": self.sid,
+            "name": self.name,
+            "cat": self.cat,
+            "start": self.start,
+            "end": end,
+            "open": self.end is None,
+            "args": dict(self.args),
+        }
+
+
+def begin(name: str, cat: str = "era", **args) -> int:
+    """Open a span; returns its id (pass to end()/annotate())."""
+    sid = next(_ids)
+    sp = _Span(sid, name, cat, time.monotonic(), args)
+    with _lock:
+        _open[sid] = sp
+    return sp.sid
+
+
+def annotate(sid: int, **args) -> None:
+    """Merge args into a still-open span (no-op once closed)."""
+    with _lock:
+        sp = _open.get(sid)
+        if sp is not None:
+            sp.args.update(args)
+
+
+def end(sid: int, **args) -> None:
+    """Close a span; idempotent (a GC sweep and a normal completion may
+    both try to close the same protocol span)."""
+    with _lock:
+        sp = _open.pop(sid, None)
+        if sp is None:
+            return
+        sp.end = time.monotonic()
+        if args:
+            sp.args.update(args)
+        _done.append(sp)
+
+
+def instant(name: str, cat: str = "era", **args) -> None:
+    """Record a zero-duration event (block persisted, watchdog firing)."""
+    sp = _Span(next(_ids), name, cat, time.monotonic(), args)
+    sp.end = sp.start
+    with _lock:
+        _done.append(sp)
+
+
+@contextmanager
+def span(name: str, cat: str = "era", **args):
+    """Scoped begin/end; yields the span id for annotate()."""
+    sid = begin(name, cat, **args)
+    try:
+        yield sid
+    finally:
+        end(sid)
+
+
+def open_spans() -> List[dict]:
+    """Snapshot of currently-open spans, oldest first (the watchdog's
+    view of what the node is stuck inside)."""
+    now = time.monotonic()
+    with _lock:
+        spans = sorted(_open.values(), key=lambda s: (s.start, s.sid))
+        return [s.to_dict(now) for s in spans]
+
+
+def open_stack_str() -> str:
+    """Human one-liner of the open-span stack for stall reports:
+    'era(era=7) > HoneyBadger > tpke.flush'."""
+    parts = []
+    for s in open_spans():
+        era = s["args"].get("era")
+        parts.append(
+            f"{s['name']}(era={era})" if era is not None else s["name"]
+        )
+    return " > ".join(parts) if parts else "<no open spans>"
+
+
+def snapshot(limit: Optional[int] = None) -> List[dict]:
+    """Finished + open spans as plain dicts, oldest first."""
+    now = time.monotonic()
+    with _lock:
+        done = list(_done)
+        live = sorted(_open.values(), key=lambda s: (s.start, s.sid))
+        out = [s.to_dict(now) for s in done + live]
+    out.sort(key=lambda d: (d["start"], d["id"]))
+    if limit is not None and limit > 0:
+        out = out[-limit:]
+    return out
+
+
+def to_chrome_trace(limit: Optional[int] = None) -> dict:
+    """Chrome trace_event JSON (load in chrome://tracing / Perfetto).
+
+    All events share one pid; tid is a lane assigned greedily so spans
+    that overlap in time (concurrent protocol instances) land on separate
+    rows instead of rendering as a false stack."""
+    events = []
+    # lane -> end time of the last span placed there
+    lanes: List[float] = []
+    for d in snapshot(limit):
+        start_us = (d["start"] - _epoch) * 1e6
+        dur_us = max((d["end"] - d["start"]) * 1e6, 0.0)
+        for tid, busy_until in enumerate(lanes):
+            if d["start"] >= busy_until:
+                lanes[tid] = d["end"]
+                break
+        else:
+            tid = len(lanes)
+            lanes.append(d["end"])
+        args = dict(d["args"])
+        if d["open"]:
+            args["open"] = True
+        events.append(
+            {
+                "name": d["name"],
+                "cat": d["cat"],
+                "ph": "X",
+                "pid": 1,
+                "tid": tid,
+                "ts": round(start_us, 1),
+                "dur": round(dur_us, 1),
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summary() -> dict:
+    """Per-span-name aggregate: {name: {count, total_ms, max_ms, open}}."""
+    agg: Dict[str, dict] = {}
+    for d in snapshot():
+        ent = agg.setdefault(
+            d["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0, "open": 0}
+        )
+        ms = (d["end"] - d["start"]) * 1e3
+        ent["count"] += 1
+        ent["total_ms"] = round(ent["total_ms"] + ms, 3)
+        ent["max_ms"] = round(max(ent["max_ms"], ms), 3)
+        if d["open"]:
+            ent["open"] += 1
+    return agg
+
+
+def set_capacity(n: int) -> None:
+    """Resize the finished-span ring (keeps the newest spans)."""
+    global _done
+    with _lock:
+        _done = deque(_done, maxlen=max(int(n), 1))
+
+
+def reset_for_tests() -> None:
+    global _done
+    with _lock:
+        _done = deque(maxlen=DEFAULT_CAPACITY)
+        _open.clear()

@@ -41,6 +41,69 @@ def test_render_text_exposition():
     assert "block_execute_seconds_count 1" in text
 
 
+def test_histogram_buckets_and_exposition():
+    metrics.reset_all_for_tests()
+    h = metrics.histogram("req_latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert abs(snap["sum"] - 5.55) < 1e-9
+    # cumulative le semantics: 0.05 <= 0.1; 0.5 lands in the 1.0 bucket
+    assert snap["buckets"] == [(0.1, 1), (1.0, 2)]
+    text = metrics.render_text()
+    assert "# TYPE req_latency histogram" in text
+    assert 'req_latency_bucket{le="0.1"} 1' in text
+    assert 'req_latency_bucket{le="1"} 2' in text
+    assert 'req_latency_bucket{le="+Inf"} 3' in text
+    assert "req_latency_count 3" in text
+    assert "req_latency_sum 5.55" in text
+
+
+def test_labeled_counters_and_histograms():
+    metrics.reset_all_for_tests()
+    metrics.inc("rpc_calls", labels={"method": "eth_call"})
+    metrics.inc("rpc_calls", 2, labels={"method": "eth_send"})
+    # same name, different labels -> distinct series
+    assert metrics.counter_value("rpc_calls", labels={"method": "eth_call"}) == 1.0
+    assert metrics.counter_value("rpc_calls", labels={"method": "eth_send"}) == 2.0
+    metrics.observe_hist(
+        "proto_duration", 0.2, buckets=(0.1, 1.0), labels={"proto": "BA"}
+    )
+    metrics.observe_hist(
+        "proto_duration", 0.05, buckets=(0.1, 1.0), labels={"proto": "RBC"}
+    )
+    text = metrics.render_text()
+    assert 'rpc_calls{method="eth_call"} 1.0' in text
+    assert 'rpc_calls{method="eth_send"} 2.0' in text
+    # one TYPE header covers every labeled series of the name
+    assert text.count("# TYPE rpc_calls counter") == 1
+    assert text.count("# TYPE proto_duration histogram") == 1
+    # label comes before le in bucket lines
+    assert 'proto_duration_bucket{proto="BA",le="1"} 1' in text
+    assert 'proto_duration_bucket{proto="RBC",le="0.1"} 1' in text
+    assert 'proto_duration_count{proto="BA"} 1' in text
+    # unlabeled registry is untouched by labeled writes
+    assert metrics.counter_value("rpc_calls") == 0.0
+
+
+def test_histogram_object_is_stable_and_unlabeled_back_compat():
+    metrics.reset_all_for_tests()
+    h1 = metrics.histogram("hot_path", buckets=(1.0,))
+    h2 = metrics.histogram("hot_path", buckets=(1.0,))
+    assert h1 is h2  # hot paths hold the cell, never re-look-up
+    h1.observe(0.5)
+    assert metrics.histogram_snapshot("hot_path")["count"] == 1
+    assert metrics.histogram_snapshot("missing") is None
+    # the pre-histogram surface still renders the same shapes
+    metrics.inc("consensus_messages_processed", 3)
+    metrics.set_gauge("chain_height", 7)
+    text = metrics.render_text()
+    assert "consensus_messages_processed 3.0" in text
+    assert "chain_height 7" in text
+
+
 def test_protocol_breadcrumbs():
     metrics.reset_all_for_tests()
     import random

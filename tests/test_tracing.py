@@ -1,0 +1,188 @@
+"""Era-lifecycle tracing: span recorder semantics, Chrome trace_event
+export, the watchdog's open-span stack, and the consensus integration
+(protocol lifetimes + TPKE flush spans through a live simulation)."""
+import json
+import random
+
+import pytest
+
+from lachain_tpu.utils import metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.reset_for_tests()
+    metrics.reset_all_for_tests()
+    yield
+    tracing.reset_for_tests()
+
+
+def test_span_nesting_and_open_stack():
+    sid_era = tracing.begin("era", era=3)
+    with tracing.span("HoneyBadger", cat="protocol", era=3):
+        stack = tracing.open_stack_str()
+        assert stack == "era(era=3) > HoneyBadger(era=3)"
+        opened = tracing.open_spans()
+        assert [s["name"] for s in opened] == ["era", "HoneyBadger"]
+        assert all(s["open"] for s in opened)
+    # the scoped span closed; the era span is still open
+    assert tracing.open_stack_str() == "era(era=3)"
+    tracing.end(sid_era, outcome="consensus")
+    assert tracing.open_stack_str() == "<no open spans>"
+    # end() is idempotent: a second close must not resurrect or duplicate
+    tracing.end(sid_era)
+    assert len(tracing.snapshot()) == 2
+
+
+def test_annotate_and_instant():
+    sid = tracing.begin("tpke.flush", cat="crypto")
+    tracing.annotate(sid, slots=12)
+    tracing.end(sid, pad_waste=0.25)
+    tracing.instant("block_persisted", cat="block", height=7)
+    spans = tracing.snapshot()
+    flush = next(s for s in spans if s["name"] == "tpke.flush")
+    assert flush["args"] == {"slots": 12, "pad_waste": 0.25}
+    blk = next(s for s in spans if s["name"] == "block_persisted")
+    assert blk["args"]["height"] == 7
+    assert blk["start"] == blk["end"]
+
+
+def test_chrome_trace_export_overlapping_lanes():
+    a = tracing.begin("era", era=1)
+    b = tracing.begin("ReliableBroadcast", cat="protocol", era=1)
+    tracing.end(b)
+    tracing.end(a)
+    out = tracing.to_chrome_trace()
+    assert out["displayTimeUnit"] == "ms"
+    events = out["traceEvents"]
+    assert len(events) == 2
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+    # the RBC span overlaps the still-open era span -> separate lanes
+    era_ev = next(e for e in events if e["name"] == "era")
+    rbc_ev = next(e for e in events if e["name"] == "ReliableBroadcast")
+    assert era_ev["tid"] != rbc_ev["tid"]
+    # the export is loadable JSON end to end
+    json.loads(json.dumps(out))
+
+
+def test_open_spans_exported_and_summary():
+    sid = tracing.begin("era", era=9)
+    out = tracing.to_chrome_trace()
+    (ev,) = out["traceEvents"]
+    assert ev["args"]["open"] is True
+    summ = tracing.summary()
+    assert summ["era"]["count"] == 1
+    assert summ["era"]["open"] == 1
+    tracing.end(sid)
+
+
+def test_ring_buffer_eviction():
+    tracing.set_capacity(16)
+    try:
+        for i in range(100):
+            tracing.instant("tick", i=i)
+        spans = tracing.snapshot()
+        assert len(spans) == 16
+        assert spans[-1]["args"]["i"] == 99  # newest kept
+        assert spans[0]["args"]["i"] == 84  # oldest evicted
+    finally:
+        tracing.set_capacity(tracing.DEFAULT_CAPACITY)
+
+
+class _Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _run_hb_sim():
+    from lachain_tpu.consensus import messages as M
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.consensus.simulator import SimulatedNetwork
+
+    n, f = 4, 1
+    pub, privs = trusted_key_gen(n, f, rng=_Rng(7))
+    net = SimulatedNetwork(pub, privs, era=0, seed=11)
+    pid = M.HoneyBadgerId(era=0)
+    for i in range(n):
+        net.post_request(i, pid, b"payload|%d|" % i + bytes(16))
+    assert net.run(
+        lambda: all(r.result_of(pid) is not None for r in net.routers)
+    )
+    return net, pid
+
+
+def test_simulation_emits_protocol_and_flush_spans():
+    """Acceptance shape: a consensus drive produces a Chrome-loadable
+    trace whose spans cover sub-protocol lifetimes and the TPKE flush,
+    with slot-count + pad-waste attributes on the flush spans."""
+    _run_hb_sim()
+    spans = tracing.snapshot()
+    names = {s["name"] for s in spans}
+    assert "HoneyBadger" in names
+    assert "ReliableBroadcast" in names
+    assert "tpke.flush" in names
+    flushes = [s for s in spans if s["name"] == "tpke.flush"]
+    for fl in flushes:
+        assert not fl["open"]
+        assert fl["args"]["slots"] >= 1
+        assert fl["args"]["slots_padded"] >= fl["args"]["slots"]
+        assert 0.0 <= fl["args"]["pad_waste"] < 1.0
+    # completed protocol spans carry their outcome and close cleanly
+    hb = [s for s in spans if s["name"] == "HoneyBadger" and not s["open"]]
+    assert hb and all(s["args"]["outcome"] == "done" for s in hb)
+    # the per-protocol-type duration histograms recorded alongside
+    assert (
+        metrics.histogram_snapshot(
+            "consensus_protocol_duration_seconds",
+            labels={"protocol": "HoneyBadger"},
+        )["count"]
+        >= 4
+    )
+    # flush metrics histograms recorded
+    assert metrics.histogram_snapshot("tpke_flush_slots")["count"] >= 1
+    # and the whole thing exports as loadable Chrome JSON
+    out = tracing.to_chrome_trace()
+    json.loads(json.dumps(out))
+    assert any(e["name"] == "tpke.flush" for e in out["traceEvents"])
+
+
+def test_watchdog_stack_names_stuck_protocol():
+    """A protocol created but never finished keeps its span open, so the
+    stall report's open-span stack names it (the round-5 blind spot)."""
+    from lachain_tpu.consensus import messages as M
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.consensus.simulator import SimulatedNetwork
+
+    pub, privs = trusted_key_gen(4, 1, rng=_Rng(3))
+    net = SimulatedNetwork(pub, privs, era=1, seed=4)
+    pid = M.BinaryAgreementId(era=1, agreement=0)
+    net.post_request(0, pid, True)  # one input only: BA cannot decide
+    net.run(lambda: False, max_messages=500)
+    stack = tracing.open_stack_str()
+    assert "BinaryAgreement" in stack
+    assert "era=1" in stack
+
+
+def test_era_gc_closes_abandoned_spans():
+    net, pid = _run_hb_sim()
+    before_open = [s["name"] for s in tracing.open_spans()]
+    # the GC keeps the last ACTIVE era's instances; a second advance
+    # pushes era 0 past the cutoff
+    for r in net.routers:
+        r.advance_era(5)
+        r.advance_era(6)
+    after = tracing.open_spans()
+    # every protocol span from the finished era got closed by the sweep
+    assert [s for s in after if s["args"].get("era") == 0] == []
+    gc_closed = [
+        s
+        for s in tracing.snapshot()
+        if s["args"].get("outcome") == "era_gc"
+    ]
+    if before_open:
+        assert gc_closed
