@@ -23,6 +23,12 @@ composition):
       (role of the reference's in-process console, CLI/ConsoleManager.cs:14
       + ConsoleCommands.cs:20; attaching over RPC means the shell works
       against any reachable node, containers included).
+  lachain-tpu chaos --drop 0.1 --crash 3@50:400 --partition 0,1|2,3@30:500
+      seeded fault-injection run against an in-process devnet: eras under
+      message loss / crash / partition schedules, with an era-by-era
+      recovery report. Same seed -> same faults -> same chain, so a
+      production failure replays from its seed (DEPLOY.md, Failure
+      handling).
 """
 from __future__ import annotations
 
@@ -437,6 +443,84 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded fault-injection run: an in-process devnet pushed through
+    `--eras` eras under a FaultPlan, printing an era/recovery report.
+    Exit 0 iff every era decided identically on every node."""
+    import time
+
+    from .core.devnet import Devnet
+    from .network.faults import FaultPlan
+    from .utils import metrics
+
+    plan = FaultPlan(
+        seed=args.seed,
+        drop=args.drop,
+        duplicate=args.duplicate,
+        delay=args.delay,
+        reorder=args.reorder,
+        crashes=tuple(FaultPlan.parse_crash(s) for s in args.crash),
+        partitions=tuple(
+            FaultPlan.parse_partition(s) for s in args.partition
+        ),
+    )
+    print(
+        f"chaos: n={args.n} f={args.f} eras={args.eras} seed={args.seed} "
+        f"engine={args.engine}"
+    )
+    print(
+        f"plan: drop={plan.drop} duplicate={plan.duplicate} "
+        f"delay={plan.delay} reorder={plan.reorder} "
+        f"crashes={len(plan.crashes)} partitions={len(plan.partitions)}"
+    )
+    try:
+        net = Devnet(
+            n=args.n,
+            f=args.f,
+            seed=args.seed,
+            fault_plan=plan,
+            engine=args.engine,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    failures = 0
+    for era in range(1, args.eras + 1):
+        t0 = time.perf_counter()
+        delivered0 = net.net.delivered_count
+        recov0 = getattr(net.net, "recovery_rounds", 0)
+        try:
+            blocks = net.run_era(era)
+        except RuntimeError as e:
+            failures += 1
+            print(f"era {era:>3}: FAILED ({e})")
+            continue
+        dt = time.perf_counter() - t0
+        print(
+            f"era {era:>3}: block {blocks[0].hash().hex()[:16]} "
+            f"msgs={net.net.delivered_count - delivered0} "
+            f"recovery_rounds={getattr(net.net, 'recovery_rounds', 0) - recov0} "
+            f"{dt:.2f}s"
+        )
+    faults = getattr(net.net, "faults", None)
+    if faults is not None:
+        print("fault report:", json.dumps(faults.stats, sort_keys=True))
+    replayed = metrics.counter_value("consensus_outbox_replayed_total")
+    evicted = metrics.counter_value("consensus_outbox_evicted_total")
+    print(
+        f"recovery report: recovery_rounds="
+        f"{getattr(net.net, 'recovery_rounds', 0)} "
+        f"outbox_replayed={int(replayed)} outbox_evicted={int(evicted)}"
+    )
+    heights = [net.height(i) for i in range(args.n)]
+    print(f"heights: {heights}")
+    if failures or len(set(heights)) != 1:
+        print("CHAOS RUN FAILED", file=sys.stderr)
+        return 1
+    print(f"ok: {args.eras} eras survived the plan")
+    return 0
+
+
 def cmd_run(args) -> int:
     from .core.config import NodeConfig
 
@@ -626,6 +710,33 @@ def main(argv=None) -> int:
     de.add_argument("--wallet", required=True)
     de.add_argument("--password", default=None)
     de.set_defaults(fn=cmd_decrypt)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="run a seeded fault scenario against an in-process devnet",
+    )
+    ch.add_argument("--n", type=int, default=4)
+    ch.add_argument("--f", type=int, default=1)
+    ch.add_argument("--eras", type=int, default=3)
+    ch.add_argument("--seed", type=int, default=0)
+    ch.add_argument("--drop", type=float, default=0.0,
+                    help="per-message loss probability")
+    ch.add_argument("--duplicate", type=float, default=0.0,
+                    help="per-message duplication probability")
+    ch.add_argument("--delay", type=float, default=0.0,
+                    help="per-message delay probability")
+    ch.add_argument("--reorder", type=float, default=0.0,
+                    help="per-message reorder probability")
+    ch.add_argument("--crash", action="append", default=[],
+                    metavar="NODE@AT[:RESTART]",
+                    help="crash schedule, repeatable (e.g. 3@50:400)")
+    ch.add_argument("--partition", action="append", default=[],
+                    metavar="A,B|C,D@AT[:HEAL]",
+                    help="partition schedule, repeatable "
+                         "(e.g. '0,1|2,3@30:500')")
+    ch.add_argument("--engine", choices=["python", "native"],
+                    default="python")
+    ch.set_defaults(fn=cmd_chaos)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -17,7 +17,8 @@ node.
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Dict, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
 
 from . import messages as M
 from .binary_agreement import BinaryAgreement
@@ -58,6 +59,14 @@ class EraRouter(Broadcaster):
         self._postponed: list = []
         self._postponed_per_sender: Dict[int, int] = {}
         self._postponed_sender_cap = 256
+        # retransmission outbox: every payload this router sent, per era
+        # (target None = broadcast), bounded FIFO. Consensus protocols never
+        # retransmit on their own, so a message lost in transit is
+        # unrecoverable for the slot UNLESS a peer can re-request it — a
+        # message_request for an era is answered by replaying from here.
+        # Finished eras are pruned with the protocol GC in advance_era.
+        self._outbox: Dict[int, deque] = {}
+        self.outbox_cap = 4096  # entries per era; oldest evicted first
 
     # -- Broadcaster interface ----------------------------------------------
     @property
@@ -73,10 +82,47 @@ class EraRouter(Broadcaster):
         return self.public_keys.f
 
     def broadcast(self, payload) -> None:
+        self._record_outbox(None, payload)
         self._send(None, payload)
 
     def send_to(self, validator: int, payload) -> None:
+        self._record_outbox(validator, payload)
         self._send(validator, payload)
+
+    # -- retransmission outbox ------------------------------------------------
+    def _record_outbox(self, target: Optional[int], payload) -> None:
+        q = self._outbox.get(self.era)
+        if q is None:
+            q = self._outbox[self.era] = deque()
+        if len(q) >= self.outbox_cap:
+            q.popleft()
+            from ..utils import metrics
+
+            metrics.inc("consensus_outbox_evicted_total")
+        q.append((target, payload))
+
+    def outbox_payloads(self, era: int, requester: int) -> List[Any]:
+        """Everything this router sent in `era` that `requester` should
+        have seen: broadcasts plus messages addressed to it directly."""
+        return [
+            payload
+            for target, payload in self._outbox.get(era, ())
+            if target is None or target == requester
+        ]
+
+    def replay_outbox(self, era: int, requester: int) -> int:
+        """Re-send `era`'s outbox to `requester` (message_request service).
+        Goes straight through the transport — NOT via send_to — so replays
+        are never re-recorded (a replay of a replay would grow the outbox
+        unboundedly)."""
+        payloads = self.outbox_payloads(era, requester)
+        for payload in payloads:
+            self._send(requester, payload)
+        if payloads:
+            from ..utils import metrics
+
+            metrics.inc("consensus_outbox_replayed_total", len(payloads))
+        return len(payloads)
 
     def internal_request(self, req: M.Request) -> None:
         proto = self._ensure_protocol(req.to_id)
@@ -147,6 +193,11 @@ class EraRouter(Broadcaster):
                 # lifetime spans so the trace doesn't report them as
                 # stuck-open forever
                 proto.close_span(outcome="era_gc")
+        # outboxes follow the same retention as protocol instances: the last
+        # active era stays serviceable for laggard re-requests, older eras
+        # are settled on-chain and recoverable by block sync instead
+        for e in [e for e in self._outbox if e < cutoff]:
+            del self._outbox[e]
         pending, self._postponed = self._postponed, []
         self._postponed_per_sender = {}
         for sender, payload in pending:

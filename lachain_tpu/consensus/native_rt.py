@@ -304,10 +304,44 @@ class NativeSimulatedNetwork:
         muted: Optional[Set[int]] = None,
         extra_factories=None,
         use_crypto_batcher: bool = True,
+        fault_plan=None,
     ):
         self.n = public_keys.n
+        self.muted = set(muted or set())
+        self.fault_plan = fault_plan
+        if fault_plan is not None:
+            # one FaultPlan, three delivery layers: here the plan maps onto
+            # the engine's own fault knobs — duplication -> repeat_ppm,
+            # reordering -> TAKE_RANDOM delivery, a crash that never
+            # restarts -> a muted player. Features the engine cannot express
+            # (probabilistic drop, delay, partitions, mid-era restart) are
+            # refused loudly rather than silently weakened: a chaos run that
+            # *looks* like it injected loss but didn't would certify a
+            # recovery path that was never exercised.
+            unsupported = []
+            if fault_plan.drop > 0:
+                unsupported.append("drop")
+            if fault_plan.delay > 0:
+                unsupported.append("delay")
+            if fault_plan.partitions:
+                unsupported.append("partitions")
+            if any(c.restart is not None for c in fault_plan.crashes):
+                unsupported.append("crash restart")
+            if unsupported:
+                raise ValueError(
+                    "native engine cannot express FaultPlan feature(s): "
+                    + ", ".join(unsupported)
+                    + " — use the python simulator (engine='python') for "
+                    "full fault injection"
+                )
+            if fault_plan.reorder > 0 and mode is DeliveryMode.TAKE_FIRST:
+                mode = DeliveryMode.TAKE_RANDOM
+            repeat_probability = max(
+                repeat_probability, fault_plan.duplicate
+            )
+            seed = seed ^ (fault_plan.seed << 1)
+            self.muted |= {c.node for c in fault_plan.crashes}
         self.mode = mode
-        self.muted = muted or set()
         self._lib = load_rt()
         mode_i = {
             DeliveryMode.TAKE_FIRST: 0,

@@ -78,6 +78,8 @@ class Protocol:
         self.started_at = _time.monotonic()
         self.last_activity = self.started_at
         self._last_kind: Optional[tuple] = None
+        # consecutive watchdog strikes with no progress; reset on receive()
+        self.stall_count = 0
         # lifetime span: closed on emit_result / exception termination, or
         # by the era GC sweep for instances an era's outcome never needed
         self._span_id = tracing.begin(
@@ -86,6 +88,13 @@ class Protocol:
             era=getattr(pid, "era", None),
             pid=str(pid),
         )
+
+    def record_stall(self) -> int:
+        """Watchdog strike: bump and return the consecutive-stall count.
+        The escalation ladder (report → re-request → reconnect) is keyed
+        off the returned value; any received message resets it."""
+        self.stall_count += 1
+        return self.stall_count
 
     # -- runtime ------------------------------------------------------------
     def receive(self, envelope) -> None:
@@ -97,6 +106,7 @@ class Protocol:
 
         metrics.MESSAGES_PROCESSED[0] += 1
         self.last_activity = metrics.monotonic()
+        self.stall_count = 0
         self._last_kind = (
             type(envelope).__name__,
             type(envelope.payload).__name__

@@ -12,6 +12,14 @@ Unlike the reference's thread-based router, delivery here is a single seeded
 loop: identical seeds replay identical executions, including adversarial
 reorderings — the determinism requirement called out in SURVEY.md §7
 ("hard parts" #3).
+
+Beyond the legacy ad-hoc knobs (mode / repeat_probability / muted), a
+`FaultPlan` (network/faults.py) injects seeded drop/delay/duplicate/reorder
+faults plus scheduled crash/restart windows and healing partitions; the
+virtual clock is the delivered-message count. Lost messages are repaired the
+same way the real node repairs them — replay from each router's per-era
+outbox — triggered here on quiescence (the in-process analogue of the
+message_request wire exchange).
 """
 from __future__ import annotations
 
@@ -46,12 +54,25 @@ class SimulatedNetwork:
         extra_factories: Optional[Dict[type, Callable]] = None,
         router_cls=EraRouter,
         use_crypto_batcher: bool = True,
+        fault_plan=None,
+        max_recovery_rounds: int = 16,
     ):
         self.n = public_keys.n
         self.rng = random.Random(seed)
         self.mode = mode
         self.repeat_probability = repeat_probability
         self.muted = muted or set()
+        # seeded fault schedule: clocked by delivered-message count so two
+        # runs with one seed replay bit-identical fault sequences
+        self.fault_plan = fault_plan
+        self._vtime = 0.0
+        self.faults = (
+            fault_plan.session(clock=lambda: self._vtime)
+            if fault_plan is not None
+            else None
+        )
+        self.recovery_rounds = 0
+        self.max_recovery_rounds = max_recovery_rounds
         # (sender, target, payload). Container picked per mode so every
         # _pop is O(1) at 2M-message eras (N=64): deque for FIFO/LIFO
         # (popleft/pop), plain list for RANDOM (indexed swap-with-last +
@@ -88,6 +109,8 @@ class SimulatedNetwork:
         def send(target: Optional[int], payload) -> None:
             if sender in self.muted:
                 return  # crashed player: no outbound traffic
+            if self.faults is not None and self.faults.crashed(sender):
+                return  # scheduled crash window: no outbound traffic
             if type(payload) is M.DecryptedMessage:
                 self._decrypted_in_queue += self.n if target is None else 1
             if target is None:
@@ -118,6 +141,15 @@ class SimulatedNetwork:
             if type(item[2]) is M.DecryptedMessage:
                 self._decrypted_in_queue += 1
             self._queue.append(item)  # duplicate injection
+        if (
+            self.faults is not None
+            and self._queue
+            and self.faults.reorder_hit()
+        ):
+            # fault-plan reordering: swap the picked message with a random
+            # queued one (composes with any DeliveryMode)
+            idx = self.faults.rng.randrange(len(self._queue))
+            item, self._queue[idx] = self._queue[idx], item
         return item
 
     # -- execution ------------------------------------------------------------
@@ -139,6 +171,8 @@ class SimulatedNetwork:
                 if batcher is not None and batcher.pending:
                     batcher.flush()
                     continue
+                if self.faults is not None and self._recover():
+                    continue
                 return done()
             if self.delivered_count >= max_messages:
                 raise RuntimeError(
@@ -146,9 +180,27 @@ class SimulatedNetwork:
                 )
             sender, target, payload = self._pop()
             self.delivered_count += 1
+            self._vtime += 1.0
             if type(payload) is M.DecryptedMessage:
                 self._decrypted_in_queue -= 1
-            if target not in self.muted:
+            deliver = True
+            if self.faults is not None and sender != target:
+                # self-delivery never traverses the network: only link
+                # traffic is subject to loss/dup/delay/partition
+                delays = self.faults.decide(sender, target)
+                deliver = bool(delays) and delays[0] <= 0
+                requeues = (len(delays) - 1) + (
+                    1 if delays and delays[0] > 0 else 0
+                )
+                for _ in range(requeues):
+                    # a delayed copy re-enters the queue and surfaces later;
+                    # a duplicate is a second full delivery
+                    if type(payload) is M.DecryptedMessage:
+                        self._decrypted_in_queue += 1
+                    self._queue.append((sender, target, payload))
+            elif self.faults is not None and self.faults.crashed(target):
+                deliver = False  # crashed: not even self-delivery
+            if deliver and target not in self.muted:
                 # crashed player: no inbound processing either
                 self.routers[target].dispatch_external(sender, payload)
             if (
@@ -161,6 +213,39 @@ class SimulatedNetwork:
                 # BinaryAgreement lag rounds spawn fresh coin work
                 batcher.flush()
         return True
+
+    def _recover(self) -> bool:
+        """Quiescent but not done under a fault plan: the wedged-era state
+        the recovery protocol exists for. Jump the virtual clock to the next
+        schedule boundary (healing partitions / restarting crashed nodes
+        needs time to pass, and quiescence means no deliveries advance it),
+        then replay every live router's per-era outbox across every
+        currently-unblocked link — the in-process model of the
+        message_request/outbox-replay wire exchange. Returns True when any
+        message was re-enqueued; bounded by max_recovery_rounds so a
+        genuinely unrecoverable plan (f+1 permanent crashes) terminates."""
+        f = self.faults
+        if self.recovery_rounds >= self.max_recovery_rounds:
+            return False
+        boundary = f.next_boundary(self._vtime)
+        if boundary is not None:
+            self._vtime = max(self._vtime, boundary)
+        self.recovery_rounds += 1
+        requeued = 0
+        for requester in range(self.n):
+            if requester in self.muted or f.crashed(requester):
+                continue
+            for responder in range(self.n):
+                if (
+                    responder == requester
+                    or responder in self.muted
+                    or f.crashed(responder)
+                    or f.partitioned(responder, requester)
+                ):
+                    continue
+                router = self.routers[responder]
+                requeued += router.replay_outbox(router.era, requester)
+        return requeued > 0
 
     def results(self, pid) -> List[Any]:
         return [r.result_of(pid) for r in self.routers]

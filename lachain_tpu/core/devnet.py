@@ -54,6 +54,8 @@ class Devnet:
         initial_balances: Optional[Dict[bytes, int]] = None,
         mode: DeliveryMode = DeliveryMode.TAKE_FIRST,
         engine: str = "python",
+        fault_plan=None,
+        max_recovery_rounds: int = 16,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
@@ -112,18 +114,27 @@ class Devnet:
         # engine="native" routes the flood protocols through the C++ runtime
         # (consensus/native_rt.py) — same protocols, same crypto, ~100x the
         # dispatch throughput at N=64.
+        # fault_plan (network/faults.py FaultPlan) threads through to the
+        # delivery layer: chaos tests and the `lachain-tpu chaos` verb run
+        # whole eras under seeded loss/partition/crash schedules
         if engine == "native":
             from ..consensus.native_rt import NativeSimulatedNetwork
 
             net_cls = NativeSimulatedNetwork
+            net_kw = dict(fault_plan=fault_plan)
         else:
             net_cls = SimulatedNetwork
+            net_kw = dict(
+                fault_plan=fault_plan,
+                max_recovery_rounds=max_recovery_rounds,
+            )
         self.net = net_cls(
             self.public_keys,
             self.private_keys,
             era=1,
             seed=seed,
             mode=mode,
+            **net_kw,
         )
         for i, router in enumerate(self.net.routers):
             router._extra_factories[M.RootProtocolId] = root_factory_for(

@@ -107,6 +107,17 @@ class Node:
         self.network.on_consensus = self._on_consensus
         self.network.on_sync_pool_reply = self._on_pool_txs
         self.network.on_ping_request = self._on_ping_request
+        self.network.on_message_request = self._on_message_request
+        # retransmission/recovery tuning (tests shrink these): the watchdog
+        # sweeps every watchdog_interval; a protocol quiet for stall_timeout
+        # escalates stall report -> outbox re-request -> forced reconnect
+        self.watchdog_interval = 10.0
+        self.stall_timeout = 60.0
+        # serving side: one outbox replay per (peer, era) per window, so a
+        # hammering (or byzantine) requester cannot turn recovery into an
+        # amplification attack
+        self.replay_min_interval = 2.0
+        self._replay_served_at: Dict[tuple, float] = {}
         self.validator_manager = ValidatorManager(self.state, public_keys)
         from .fast_sync import FastSynchronizer
 
@@ -231,30 +242,39 @@ class Node:
             self._warmup_thread = None
 
     async def _protocol_watchdog(self) -> None:
-        """60s protocol stall watchdog with last-message breadcrumb
-        (reference AbstractProtocol 'taking too long' warnings,
-        AbstractProtocol.cs:113-135)."""
+        """Protocol stall watchdog with last-message breadcrumb (reference
+        AbstractProtocol 'taking too long' warnings, AbstractProtocol.cs:
+        113-135) — escalating instead of merely reporting. Consensus never
+        retransmits, so a stall that outlives one report is most likely a
+        LOST message, not a slow peer: the second strike re-requests the
+        era's traffic from every live peer (outbox replay), the third also
+        forces the transport to drop cached sockets and re-dial."""
         import time as _time
 
         while not self._stopping:
-            await asyncio.sleep(10.0)
+            await asyncio.sleep(self.watchdog_interval)
             router = self.router
             if router is None:
                 continue
             now = _time.monotonic()
+            # aggregate the ladder per era: one sweep re-requests/reconnects
+            # once, however many of the era's protocols are stalled
+            era_stage: Dict[int, int] = {}
             for pid, proto in list(router._protocols.items()):
                 if proto.terminated or proto.result is not None:
                     continue
                 stalled = now - proto.last_activity
-                if stalled > 60.0:
+                if stalled > self.stall_timeout:
                     from ..utils import tracing
 
+                    stage = proto.record_stall()
                     logger.warning(
                         "protocol %s stalled for %.0fs (alive %.0fs, "
-                        "last message: %s, open spans: %s)",
+                        "strike %d, last message: %s, open spans: %s)",
                         pid,
                         stalled,
                         now - proto.started_at,
+                        stage,
                         proto.last_message,
                         tracing.open_stack_str(),
                     )
@@ -263,9 +283,47 @@ class Node:
                         cat="watchdog",
                         pid=str(pid),
                         stalled_s=round(stalled, 1),
+                        stage=stage,
                         last_message=proto.last_message,
                     )
                     proto.last_activity = now  # re-arm, don't spam
+                    era = getattr(pid, "era", router.era)
+                    era_stage[era] = max(era_stage.get(era, 0), stage)
+            for era, stage in era_stage.items():
+                self._escalate_stall(era, stage)
+
+    def _escalate_stall(self, era: int, stage: int) -> None:
+        """Stage 2+: ask every live peer to replay its outbox for `era`
+        (and replay our own outbox back at them — the loss may have been
+        OUR message). Stage 3+: also force the transport to reconnect."""
+        from ..utils import metrics
+
+        if stage < 2:
+            return
+        metrics.inc(
+            "consensus_stall_escalations_total",
+            labels={"stage": str(min(stage, 3))},
+        )
+        logger.warning(
+            "era %d stalled (strike %d): re-requesting consensus traffic "
+            "from %d peers",
+            era,
+            stage,
+            len(self.network.peers),
+        )
+        self.network.broadcast(wire.message_request(era))
+        if stage == 2 and self.router is not None and self.router.era == era:
+            # push our own outbox once unprompted: the lost message may have
+            # been OURS, and a peer wedged badly enough may never get its
+            # own re-request out. Later strikes rely on the peers' replies
+            # (re-pushing thousands of messages every sweep helps nobody).
+            for idx, pub in self._pub_by_index.items():
+                if idx == self.index:
+                    continue
+                for payload in self.router.outbox_payloads(era, idx):
+                    self.network.send_to(pub, wire.consensus_msg(era, payload))
+        if stage >= 3:
+            self.network.reconnect_peers()
 
     async def start_rpc(
         self,
@@ -340,6 +398,46 @@ class Node:
         self.network.send_to(
             sender, wire.ping_reply(self.block_manager.current_height())
         )
+
+    def _on_message_request(self, sender_pub: bytes, era: int) -> None:
+        """A peer is missing consensus traffic for `era`: replay our outbox
+        to it (reference message-request/resend layer). Served only for eras
+        the router still retains — older eras are settled on-chain and the
+        requester's recovery path is block sync, which its next height probe
+        triggers anyway."""
+        import time as _time
+
+        from ..utils import metrics
+
+        if self.router is None:
+            return
+        sender = self._index_by_pub.get(sender_pub)
+        if sender is None or sender == self.index:
+            return
+        now = _time.monotonic()
+        key = (sender_pub, era)
+        last = self._replay_served_at.get(key)
+        if last is not None and now - last < self.replay_min_interval:
+            metrics.inc("consensus_replay_rate_limited_total")
+            return
+        self._replay_served_at[key] = now
+        if len(self._replay_served_at) > 4096:  # spam/memory bound
+            self._replay_served_at = {
+                k: v
+                for k, v in self._replay_served_at.items()
+                if now - v < self.replay_min_interval
+            }
+        payloads = self.router.outbox_payloads(era, sender)
+        for payload in payloads:
+            self.network.send_to(sender_pub, wire.consensus_msg(era, payload))
+        if payloads:
+            metrics.inc("consensus_outbox_replayed_total", len(payloads))
+            logger.info(
+                "replayed %d era-%d messages to %s",
+                len(payloads),
+                era,
+                sender_pub.hex()[:16],
+            )
 
     # -- consensus plumbing -------------------------------------------------
 

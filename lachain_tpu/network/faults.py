@@ -1,0 +1,276 @@
+"""Deterministic fault injection: one plan, every delivery layer.
+
+A :class:`FaultPlan` is a seeded, declarative description of an adversarial
+network — message loss/delay/duplication/reordering probabilities, link-level
+partitions with heal times, and scheduled peer crash/restart windows. The
+same plan object drives three delivery layers:
+
+  * the in-process simulator (`consensus/simulator.py`) — virtual clock is
+    the delivered-message count, recovery is modeled by outbox replay on
+    quiescence;
+  * the native engine (`consensus/native_rt.py`) — the plan maps onto the
+    engine's own knobs (duplicate ppm, reorder mode, muted players);
+  * the real TCP path (`network/hub.py`) — a :class:`TcpFrameFilter` built
+    from the plan drops/delays/duplicates framed batches on the socket,
+    clocked by wall time.
+
+Every probabilistic decision draws from a `random.Random` seeded from
+`(plan.seed, salt)`: a layer replaying the same decision sequence replays
+the same faults, which is what makes a recorded production failure
+reproducible from its seed (HoneyBadgerBFT only guarantees liveness under
+eventual delivery — the recovery layer must be provoked deterministically
+to be testable at all).
+
+Time units are layer-relative: the simulator clocks in delivered messages,
+the TCP filter in seconds since installation. A plan authored for one layer
+therefore needs its schedule rescaled for the other; probabilities carry
+over unchanged.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from ..utils import metrics
+
+
+@dataclass(frozen=True)
+class Crash:
+    """Node `node` crashes at `at` and restarts at `restart` (None = never).
+
+    A crashed node neither sends nor processes; on restart it rejoins with
+    its in-memory state intact (process-level restart with state loss is the
+    block-sync path, not this layer's job)."""
+
+    node: int
+    at: float
+    restart: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Link-level split: traffic between `side_a` and `side_b` is blocked
+    from `at` until `heal` (None = never heals). Intra-side traffic and
+    nodes on neither side are unaffected."""
+
+    side_a: FrozenSet[int]
+    side_b: FrozenSet[int]
+    at: float
+    heal: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Seeded adversarial schedule. All probabilities are per-message."""
+
+    seed: int = 0
+    drop: float = 0.0        # message silently lost
+    duplicate: float = 0.0   # message delivered twice
+    delay: float = 0.0       # message deferred (re-queued / timer-delayed)
+    reorder: float = 0.0     # message swapped with a random queued one
+    delay_span: Tuple[float, float] = (1.0, 16.0)  # sampled delay bounds
+    partitions: Tuple[Partition, ...] = ()
+    crashes: Tuple[Crash, ...] = ()
+
+    def session(
+        self, clock: Optional[Callable[[], float]] = None, salt: int = 0
+    ) -> "FaultSession":
+        """A live decision stream for one delivery layer. `clock` supplies
+        the layer's notion of now (defaults to seconds since creation);
+        `salt` decorrelates per-node streams over TCP, where each node owns
+        its outbound decisions and there is no global draw order."""
+        return FaultSession(self, clock=clock, salt=salt)
+
+    # -- schedule queries (clock-explicit; sessions wrap these) -------------
+
+    def crashed(self, node: int, now: float) -> bool:
+        for c in self.crashes:
+            if c.node == node and c.at <= now and (
+                c.restart is None or now < c.restart
+            ):
+                return True
+        return False
+
+    def partitioned(self, a: int, b: int, now: float) -> bool:
+        for p in self.partitions:
+            if p.at <= now and (p.heal is None or now < p.heal):
+                if (a in p.side_a and b in p.side_b) or (
+                    a in p.side_b and b in p.side_a
+                ):
+                    return True
+        return False
+
+    def next_boundary(self, after: float) -> Optional[float]:
+        """Earliest schedule edge strictly after `after` — the point a
+        quiesced simulator must jump its virtual clock to, so partitions
+        heal and crashed nodes restart even with no traffic in flight."""
+        edges: List[float] = []
+        for c in self.crashes:
+            edges.extend(t for t in (c.at, c.restart) if t is not None)
+        for p in self.partitions:
+            edges.extend(t for t in (p.at, p.heal) if t is not None)
+        future = [t for t in edges if t > after]
+        return min(future) if future else None
+
+    # -- CLI spec parsing ----------------------------------------------------
+
+    @staticmethod
+    def parse_crash(spec: str) -> Crash:
+        """"NODE@AT[:RESTART]" — e.g. "1@400:1200", "2@300"."""
+        node_s, _, times = spec.partition("@")
+        if not times:
+            raise ValueError(f"crash spec {spec!r}: expected NODE@AT[:RESTART]")
+        at_s, _, restart_s = times.partition(":")
+        return Crash(
+            node=int(node_s),
+            at=float(at_s),
+            restart=float(restart_s) if restart_s else None,
+        )
+
+    @staticmethod
+    def parse_partition(spec: str) -> Partition:
+        """"A,B|C,D@AT[:HEAL]" — e.g. "0,1|2,3@300:900"."""
+        sides, _, times = spec.partition("@")
+        if not times:
+            raise ValueError(
+                f"partition spec {spec!r}: expected A,B|C,D@AT[:HEAL]"
+            )
+        a_s, _, b_s = sides.partition("|")
+        if not b_s:
+            raise ValueError(f"partition spec {spec!r}: missing '|'")
+        at_s, _, heal_s = times.partition(":")
+        return Partition(
+            side_a=frozenset(int(x) for x in a_s.split(",") if x),
+            side_b=frozenset(int(x) for x in b_s.split(",") if x),
+            at=float(at_s),
+            heal=float(heal_s) if heal_s else None,
+        )
+
+
+class FaultSession:
+    """One layer's live execution of a FaultPlan: seeded rng + stats.
+
+    All decisions are drawn from a private `random.Random((seed << 20) ^
+    salt)`; a layer that replays the same sequence of `decide()` calls
+    replays the same faults."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        clock: Optional[Callable[[], float]] = None,
+        salt: int = 0,
+    ):
+        import random
+
+        self.plan = plan
+        if clock is None:
+            t0 = time.monotonic()
+            clock = lambda: time.monotonic() - t0  # noqa: E731
+        self._clock = clock
+        self.rng = random.Random((plan.seed << 20) ^ (salt & 0xFFFFF))
+        self.stats: Dict[str, int] = {
+            "dropped": 0,
+            "duplicated": 0,
+            "delayed": 0,
+            "reordered": 0,
+            "blocked": 0,   # partition / crash suppression
+            "delivered": 0,
+        }
+
+    @property
+    def now(self) -> float:
+        return self._clock()
+
+    # -- schedule state ------------------------------------------------------
+
+    def crashed(self, node: Optional[int]) -> bool:
+        return node is not None and self.plan.crashed(node, self.now)
+
+    def partitioned(self, a: Optional[int], b: Optional[int]) -> bool:
+        if a is None or b is None:
+            return False
+        return self.plan.partitioned(a, b, self.now)
+
+    def link_blocked(self, src: Optional[int], dst: Optional[int]) -> bool:
+        return (
+            self.crashed(src)
+            or self.crashed(dst)
+            or self.partitioned(src, dst)
+        )
+
+    def next_boundary(self, after: Optional[float] = None) -> Optional[float]:
+        return self.plan.next_boundary(self.now if after is None else after)
+
+    # -- per-message decisions ----------------------------------------------
+
+    def decide(self, src: Optional[int], dst: Optional[int]) -> List[float]:
+        """The fate of one message on the src->dst link: a list of delivery
+        delays, one per copy. `[]` = dropped, `[0.0]` = delivered now,
+        `[0.0, 0.0]` = duplicated, `[d]` = delivered after `d` time units.
+        Unknown endpoints (None) skip link-state checks but still roll the
+        probabilistic faults."""
+        p = self.plan
+        if self.link_blocked(src, dst):
+            self.stats["blocked"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "blocked"})
+            return []
+        if p.drop > 0 and self.rng.random() < p.drop:
+            self.stats["dropped"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "drop"})
+            return []
+        delays = [0.0]
+        if p.delay > 0 and self.rng.random() < p.delay:
+            lo, hi = p.delay_span
+            delays[0] = lo + self.rng.random() * (hi - lo)
+            self.stats["delayed"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "delay"})
+        if p.duplicate > 0 and self.rng.random() < p.duplicate:
+            delays.append(0.0)
+            self.stats["duplicated"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "dup"})
+        self.stats["delivered"] += 1
+        return delays
+
+    def reorder_hit(self) -> bool:
+        """One roll of the reorder die (the queue owner does the swap)."""
+        if self.plan.reorder <= 0 or self.rng.random() >= self.plan.reorder:
+            return False
+        self.stats["reordered"] += 1
+        metrics.inc("fault_injected_total", labels={"action": "reorder"})
+        return True
+
+
+class TcpFrameFilter:
+    """Injectable Hub frame filter executing a FaultPlan over real sockets.
+
+    Installed via `Hub.frame_filter` (or `NetworkManager.install_faults`).
+    Outbound frames to a mapped peer run the full link decision — a dropped
+    frame still reports success to the sender, so loss is only repairable
+    by the message-request/outbox-replay layer, exactly like real loss.
+    Inbound frames are suppressed only while WE are crashed (probabilistic
+    loss is owned by the sending side, so per-link loss is rolled once).
+    """
+
+    def __init__(
+        self,
+        session: FaultSession,
+        my_id: int,
+        peer_index: Optional[Callable[[object], Optional[int]]] = None,
+    ):
+        self.session = session
+        self.my_id = my_id
+        # peer_index(PeerAddress) -> plan node id (None = unmapped peer:
+        # link checks are skipped, probabilistic faults still apply)
+        self._peer_index = peer_index or (lambda peer: None)
+
+    def outbound(self, peer, data: bytes) -> List[float]:
+        dst = self._peer_index(peer) if peer is not None else None
+        return self.session.decide(self.my_id, dst)
+
+    def inbound(self, data: bytes) -> List[float]:
+        if self.session.crashed(self.my_id):
+            self.session.stats["blocked"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "blocked"})
+            return []
+        return [0.0]

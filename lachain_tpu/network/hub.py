@@ -66,9 +66,15 @@ class Hub:
         host: str,
         port: int,
         on_batch: Callable[..., None],
+        frame_filter=None,
     ):
         self.host = host
         self.port = port
+        # injectable fault filter (network/faults.py TcpFrameFilter): decides
+        # per-frame drop/delay/duplication so a seeded FaultPlan reproduces
+        # a failure over real sockets. None = deliver everything.
+        self.frame_filter = frame_filter
+        self._fault_tasks: set = set()
         # called as on_batch(data, conn_id) when the callable accepts two
         # positional args, else on_batch(data) — conn_id identifies the
         # INBOUND connection the batch arrived on, for reverse delivery to
@@ -94,6 +100,9 @@ class Hub:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+        for t in list(self._fault_tasks):
+            t.cancel()
+        self._fault_tasks.clear()
         # cancel inbound readers first: wait_closed() (3.12+) blocks until
         # every connection handler returns
         for t in list(self._reader_tasks):
@@ -118,6 +127,10 @@ class Hub:
             metrics.observe_hist(
                 "network_frame_bytes", n, buckets=_FRAME_BUCKETS
             )
+            if self.frame_filter is not None and not self.frame_filter.inbound(
+                data
+            ):
+                continue  # injected inbound suppression (crashed self)
             try:
                 if self._pass_conn_id:
                     self.on_batch(data, conn_id)
@@ -145,9 +158,47 @@ class Hub:
             if task is not None:
                 self._reader_tasks.discard(task)
 
+    def _schedule_faulted(self, delay: float, send) -> None:
+        """Run coroutine-factory `send` after `delay` (fault-injected
+        latency); tracked so stop() cancels in-flight delayed frames."""
+
+        async def later():
+            await asyncio.sleep(delay)
+            await send()
+
+        t = asyncio.get_running_loop().create_task(later())
+        self._fault_tasks.add(t)
+        t.add_done_callback(self._fault_tasks.discard)
+
+    async def _send_filtered(self, peer, data: bytes, send) -> bool:
+        """Apply the frame filter to one outbound frame. `send` is an async
+        thunk performing the real write. A dropped frame reports SUCCESS:
+        injected loss must look like the network ate it, so repair can only
+        come from the message-request/outbox-replay layer — a False here
+        would let the worker's own requeue path mask the fault."""
+        plan = self.frame_filter.outbound(peer, data)
+        if not plan:
+            return True
+        ok = True
+        sent_now = False
+        for delay in plan:
+            if delay > 0:
+                self._schedule_faulted(delay, send)
+            else:
+                sent_now = True
+                ok = await send() and ok
+        return ok if sent_now else True
+
     async def send_on_conn(self, conn_id: int, data: bytes) -> bool:
         """Reverse delivery over a live INBOUND connection (the only path
         to a NAT'd peer: it dialed us, we answer on its socket)."""
+        if self.frame_filter is not None:
+            return await self._send_filtered(
+                None, data, lambda: self._send_on_conn_now(conn_id, data)
+            )
+        return await self._send_on_conn_now(conn_id, data)
+
+    async def _send_on_conn_now(self, conn_id: int, data: bytes) -> bool:
         writer = self._inbound.get(conn_id)
         if writer is None:
             return False
@@ -179,6 +230,13 @@ class Hub:
     async def send_raw(self, peer: PeerAddress, data: bytes) -> bool:
         """Send one framed batch; dials on demand, drops the cached
         connection on failure (next send re-dials)."""
+        if self.frame_filter is not None:
+            return await self._send_filtered(
+                peer, data, lambda: self._send_raw_now(peer, data)
+            )
+        return await self._send_raw_now(peer, data)
+
+    async def _send_raw_now(self, peer: PeerAddress, data: bytes) -> bool:
         key = (peer.host, peer.port)
         lock = self._conn_locks.setdefault(key, asyncio.Lock())
         async with lock:

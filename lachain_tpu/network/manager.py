@@ -14,6 +14,7 @@ import logging
 import zlib
 from typing import Callable, Dict, List, Optional
 
+from ..utils import metrics
 from . import wire
 from .hub import Hub, PeerAddress
 from .wire import MessageBatch, MessageFactory, NetworkMessage
@@ -59,6 +60,9 @@ class NetworkManager:
         self.on_sync_blocks_reply: Optional[Callable] = None
         self.on_sync_pool_request: Optional[Callable] = None
         self.on_sync_pool_reply: Optional[Callable] = None
+        # consensus retransmission: fn(sender_pubkey, era) — the node
+        # answers by replaying its era outbox to the sender
+        self.on_message_request: Optional[Callable[[bytes, int], None]] = None
         # gossip peer discovery: fired when a previously-unknown peer is
         # learned from a peers_reply (after the worker already exists)
         self.on_peer_discovered: Optional[Callable[[PeerAddress], None]] = None
@@ -107,7 +111,14 @@ class NetworkManager:
                 rereg()
             )
         except RuntimeError:
-            pass  # no loop (offline construction); caller re-registers
+            # no loop (offline construction): without periodic
+            # re-registration the relay's TTL expires in 90s and reverse
+            # delivery silently stops — surface it instead of skipping
+            logger.warning(
+                "use_relay without a running event loop: relay "
+                "re-registration NOT scheduled; caller must re-register"
+            )
+            metrics.inc("network_relay_reregister_skipped_total")
 
     @property
     def advertised_host_port(self):
@@ -265,14 +276,7 @@ class NetworkManager:
                 batch = self.factory.batch([msg])
                 self._send_inbound(public_key, batch.encode(), msg)
                 return
-            pending = self._undelivered.setdefault(public_key, [])
-            if len(pending) < self._undelivered_cap:
-                pending.append(msg)
-            else:
-                logger.warning(
-                    "undelivered buffer full for unknown peer %s",
-                    public_key.hex()[:16],
-                )
+            self._buffer_undelivered(public_key, msg)
             return
         worker.enqueue(msg)
 
@@ -280,6 +284,19 @@ class NetworkManager:
         pending = self._undelivered.setdefault(public_key, [])
         if len(pending) < self._undelivered_cap:
             pending.append(msg)
+        else:
+            # a silently-vanished consensus message here is exactly the
+            # wedged-era failure mode: make the loss observable so the
+            # metric can alarm and the log names the victim
+            logger.warning(
+                "undelivered buffer full for peer %s: dropping kind=%d",
+                public_key.hex()[:16],
+                msg.kind,
+            )
+            metrics.inc(
+                "network_undelivered_dropped_total",
+                labels={"kind": str(msg.kind)},
+            )
 
     def _send_inbound(
         self, public_key: bytes, data: bytes, msg=None
@@ -328,6 +345,47 @@ class NetworkManager:
     def broadcast(self, msg: NetworkMessage) -> None:
         for worker in self._workers.values():
             worker.enqueue(msg)
+
+    # -- failure handling ----------------------------------------------------
+
+    def install_faults(self, plan, my_id: int, salt: Optional[int] = None):
+        """Wire a FaultPlan into this node's TCP path: frames to peers run
+        the plan's link decisions (dst resolved by worker pubkey -> the
+        index the caller registers via `map_fault_peer`). Returns the
+        TcpFrameFilter so tests/CLI can read its stats."""
+        from .faults import TcpFrameFilter
+
+        session = plan.session(salt=my_id if salt is None else salt)
+        self._fault_peer_ids: Dict[bytes, int] = {}
+
+        def peer_index(peer) -> Optional[int]:
+            if peer is None:
+                return None
+            return self._fault_peer_ids.get(peer.public_key)
+
+        filt = TcpFrameFilter(session, my_id, peer_index)
+        self.hub.frame_filter = filt
+        return filt
+
+    def map_fault_peer(self, public_key: bytes, node_id: int) -> None:
+        """Tell the installed fault filter which plan node a transport
+        identity is (link-level partitions/crashes need the mapping)."""
+        getattr(self, "_fault_peer_ids", {})[public_key] = node_id
+
+    def reconnect_peers(self) -> None:
+        """Stall-escalation last resort: drop every cached outbound socket
+        and reset worker backoff, so the next flush re-dials immediately
+        instead of waiting out an exponential-backoff window against a
+        peer that already recovered."""
+        metrics.inc("network_forced_reconnect_total")
+        logger.warning(
+            "forcing reconnect of %d peer connections", len(self.hub._conns)
+        )
+        for w in list(self.hub._conns.values()):
+            w.close()
+        self.hub._conns.clear()
+        for worker in self._workers.values():
+            worker.reset_backoff()
 
     # -- receiving ---------------------------------------------------------
 
@@ -386,6 +444,8 @@ class NetworkManager:
             self.on_trie_nodes_request(sender, wire.parse_trie_nodes_request(msg))
         elif k == wire.KIND_TRIE_NODES_REPLY and self.on_trie_nodes_reply:
             self.on_trie_nodes_reply(sender, wire.parse_trie_nodes_reply(msg))
+        elif k == wire.KIND_MESSAGE_REQUEST and self.on_message_request:
+            self.on_message_request(sender, wire.parse_message_request(msg))
         elif k == wire.KIND_PEERS_REQUEST:
             self._on_peers_request(sender, msg)
         elif k == wire.KIND_PEERS_REPLY:

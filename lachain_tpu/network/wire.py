@@ -167,6 +167,11 @@ KIND_PEERS_REPLY = 13
 # messages, delivered back over its own outbound TCP connection
 KIND_RELAY_REGISTER = 14
 KIND_RELAY_FORWARD = 15
+# consensus retransmission (role of the reference node's message-request/
+# resend layer): HBBFT protocols never retransmit, so a node missing
+# messages for an era re-requests them; the receiver replays its per-era
+# outbox (consensus/era.py) addressed to the requester
+KIND_MESSAGE_REQUEST = 16
 
 # reference NetworkMessagePriority: replies < consensus < pool sync
 PRIORITY = {
@@ -185,6 +190,7 @@ PRIORITY = {
     KIND_PEERS_REPLY: 2,
     KIND_RELAY_REGISTER: 1,
     KIND_RELAY_FORWARD: 1,  # carries consensus traffic: consensus priority
+    KIND_MESSAGE_REQUEST: 1,  # unblocks consensus: consensus priority
 }
 
 
@@ -214,6 +220,21 @@ def parse_consensus(msg: NetworkMessage) -> Tuple[int, object]:
     r = Reader(msg.body)
     era = r.i64()
     return era, decode_payload(r.rest())
+
+
+def message_request(era: int) -> NetworkMessage:
+    """Ask a peer to replay its consensus outbox for `era` to us — the
+    recovery path for a wedged era (a lost RBC ECHO is unrecoverable for
+    its slot without retransmission). Replays are rate-limited per
+    (peer, era) on the serving side."""
+    return NetworkMessage(KIND_MESSAGE_REQUEST, write_i64(era))
+
+
+def parse_message_request(msg: NetworkMessage) -> int:
+    r = Reader(msg.body)
+    era = r.i64()
+    r.assert_eof()
+    return era
 
 
 def ping_request(height: int) -> NetworkMessage:

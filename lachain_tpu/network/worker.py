@@ -8,9 +8,12 @@ flushed at ~4 Hz — but as an asyncio task instead of a thread.
 from __future__ import annotations
 
 import asyncio
+import random
+import zlib
 from collections import deque
 from typing import List, Optional
 
+from ..utils import metrics
 from .hub import Hub, PeerAddress
 from .wire import MessageBatch, MessageFactory, NetworkMessage, PRIORITY
 
@@ -58,6 +61,15 @@ class ClientWorker:
         self._queued_bytes = 0
         self._backoff = flush_interval
         self.consecutive_failures = 0
+        # ±25% reconnect jitter, seeded per (us, peer) pair: deterministic
+        # for replay, yet different across peers — after a relay blip every
+        # worker fleet-wide would otherwise redial in lockstep at exactly
+        # backoff*2^k and re-stampede the returning host
+        jitter_seed = zlib.crc32(
+            factory.public_key
+            + (peer.public_key if peer is not None else b"")
+        )
+        self._jitter = random.Random(jitter_seed)
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -70,6 +82,13 @@ class ClientWorker:
 
     def _pending(self) -> bool:
         return any(self._queues.values())
+
+    def reset_backoff(self) -> None:
+        """Stall-escalation hook: the peer is believed back — retry NOW
+        (the queued/undelivered buffer drains on the first successful
+        flush) instead of sleeping out the current backoff window."""
+        self._backoff = self._flush_interval
+        self._wakeup.set()
 
     def enqueue(self, msg: NetworkMessage) -> None:
         self._queues[PRIORITY[msg.kind]].append(msg)
@@ -126,12 +145,15 @@ class ClientWorker:
                     # every send_raw re-dials, so recovery is the first
                     # successful dial after the peer returns
                     self.consecutive_failures += 1
+                    metrics.inc("network_reconnect_attempts_total")
                     for m in reversed(msgs):
                         # requeue at the FRONT of each priority queue so
                         # ordering within a priority is preserved
                         self._queues[PRIORITY[m.kind]].appendleft(m)
                         self._queued_bytes += len(m.body) + 6
-                    await asyncio.sleep(self._backoff)
+                    await asyncio.sleep(
+                        self._backoff * (0.75 + 0.5 * self._jitter.random())
+                    )
                     self._backoff = min(self._backoff * 2, BACKOFF_MAX)
                     break
         # final flush on stop

@@ -1,0 +1,232 @@
+"""Chaos suite: whole eras under seeded fault plans.
+
+HoneyBadgerBFT only guarantees liveness under eventual delivery; the
+transport never retransmits on its own. These tests inject deterministic
+loss/duplication/reordering, scheduled crash/restart windows, and healing
+partitions (network/faults.py) and assert the recovery layer — per-era
+outbox replay on quiescence, the in-process model of the message_request
+wire exchange — carries every era to an identical decision anyway.
+
+Marked `chaos`: full devnet eras with real threshold crypto, slower than
+the unit suites but still CPU-tier.
+"""
+import pytest
+
+from lachain_tpu.consensus import messages as M
+from lachain_tpu.core.devnet import Devnet
+from lachain_tpu.network.faults import Crash, FaultPlan, Partition
+
+pytestmark = pytest.mark.chaos
+
+
+def run_chaos_devnet(plan, *, n=4, f=1, seed=3, eras=2, **kw):
+    d = Devnet(n=n, f=f, seed=seed, fault_plan=plan, **kw)
+    blocks = d.run_eras(1, eras)
+    return d, blocks
+
+
+# ---------------------------------------------------------------------------
+# lossy link: drop + duplicate + reorder
+# ---------------------------------------------------------------------------
+
+
+def test_eras_survive_lossy_network():
+    plan = FaultPlan(seed=7, drop=0.10, duplicate=0.05, reorder=0.05)
+    d, blocks = run_chaos_devnet(plan)
+    assert [d.height(i) for i in range(4)] == [2, 2, 2, 2]
+    # the plan actually fired: this is a chaos test, not a sunny-day rerun
+    assert d.net.faults.stats["dropped"] > 0
+    assert d.net.faults.stats["duplicated"] > 0
+    # loss was healed by outbox replay, not luck
+    assert d.net.recovery_rounds > 0
+
+
+def test_lossy_network_is_bit_identical_across_runs():
+    """Same seed -> same fault sequence -> same recovery -> same chain.
+
+    This is the property that makes a recorded production failure
+    replayable: block hashes (not just heights) must match, and so must
+    the delivered-message count and the fault tally."""
+    plan = FaultPlan(seed=7, drop=0.10, duplicate=0.05, reorder=0.05)
+    runs = []
+    for _ in range(2):
+        d, blocks = run_chaos_devnet(plan)
+        runs.append(
+            (
+                [b.hash() for b in blocks],
+                d.net.delivered_count,
+                dict(d.net.faults.stats),
+            )
+        )
+    assert runs[0] == runs[1]
+
+
+def test_delayed_messages_still_decide():
+    plan = FaultPlan(seed=9, delay=0.10, delay_span=(1.0, 64.0))
+    d, blocks = run_chaos_devnet(plan, eras=1)
+    assert [d.height(i) for i in range(4)] == [1, 1, 1, 1]
+    assert d.net.faults.stats["delayed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# crash / restart
+# ---------------------------------------------------------------------------
+
+
+def test_era_survives_crash_and_restart():
+    """Node 3 crashes 50 deliveries in and restarts at 400: while down it
+    neither sends nor processes, and the messages it missed are only
+    recoverable via outbox replay — the era must still decide on ALL
+    nodes (Devnet.run_era asserts identical block hashes)."""
+    plan = FaultPlan(seed=11, crashes=(Crash(node=3, at=50, restart=400),))
+    d, blocks = run_chaos_devnet(plan, seed=5, eras=1)
+    assert [d.height(i) for i in range(4)] == [1, 1, 1, 1]
+    assert d.net.faults.stats["blocked"] > 0
+    assert d.net.recovery_rounds > 0
+
+
+def test_permanent_crash_of_f_nodes_still_decides():
+    """f=1 permanently-crashed node: the other 3 (= n-f) must decide
+    without it. The crashed node itself cannot — run_era would wait on
+    it forever, so drive the root protocols directly."""
+    plan = FaultPlan(seed=12, crashes=(Crash(node=2, at=0),))
+    d = Devnet(n=4, f=1, seed=5, fault_plan=plan)
+    for router in d.net.routers:
+        router.advance_era(1)
+    pid = M.RootProtocolId(era=1)
+    for i in range(4):
+        d.net.post_request(i, pid, None)
+
+    def live_decided():
+        return all(
+            d.net.routers[i].result_of(pid) is not None
+            for i in range(4)
+            if i != 2
+        )
+
+    assert d.net.run(live_decided, max_messages=2_000_000)
+    blocks = [d.net.routers[i].result_of(pid) for i in (0, 1, 3)]
+    assert len({b.hash() for b in blocks}) == 1
+    assert d.net.routers[2].result_of(pid) is None
+
+
+# ---------------------------------------------------------------------------
+# partitions
+# ---------------------------------------------------------------------------
+
+
+def test_era_survives_healed_partition():
+    """{0,1} | {2,3} from t=30: neither side holds a 2f+1=3 quorum, so the
+    era CANNOT decide until the heal at t=500 — quiescence recovery must
+    jump the clock across the heal boundary and replay outboxes over the
+    reopened links."""
+    plan = FaultPlan(
+        seed=13,
+        partitions=(
+            Partition(frozenset({0, 1}), frozenset({2, 3}), at=30, heal=500),
+        ),
+    )
+    d, blocks = run_chaos_devnet(plan, seed=5, eras=1)
+    assert [d.height(i) for i in range(4)] == [1, 1, 1, 1]
+    assert d.net.faults.stats["blocked"] > 0
+    assert d.net.recovery_rounds > 0
+
+
+def test_unhealed_partition_does_not_livelock():
+    """A never-healing 2/2 split is unrecoverable (no quorum anywhere):
+    the run must terminate via the recovery-round cap, not spin."""
+    plan = FaultPlan(
+        seed=14,
+        partitions=(
+            Partition(frozenset({0, 1}), frozenset({2, 3}), at=0),
+        ),
+    )
+    d = Devnet(n=4, f=1, seed=5, fault_plan=plan, max_recovery_rounds=4)
+    for router in d.net.routers:
+        router.advance_era(1)
+    pid = M.RootProtocolId(era=1)
+    for i in range(4):
+        d.net.post_request(i, pid, None)
+    done = lambda: all(  # noqa: E731
+        r.result_of(pid) is not None for r in d.net.routers
+    )
+    assert d.net.run(done, max_messages=2_000_000) is False
+    assert d.net.recovery_rounds == 4
+
+
+# ---------------------------------------------------------------------------
+# combined scenario
+# ---------------------------------------------------------------------------
+
+
+def test_loss_plus_crash_plus_partition_combined():
+    plan = FaultPlan(
+        seed=21,
+        drop=0.05,
+        duplicate=0.03,
+        reorder=0.03,
+        crashes=(Crash(node=1, at=80, restart=600),),
+        partitions=(
+            Partition(frozenset({0}), frozenset({3}), at=40, heal=700),
+        ),
+    )
+    d, blocks = run_chaos_devnet(plan, seed=8, eras=2)
+    assert [d.height(i) for i in range(4)] == [2, 2, 2, 2]
+
+
+# ---------------------------------------------------------------------------
+# plan parsing / schedule queries (cheap unit checks ride along)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_crash_and_partition_specs():
+    c = FaultPlan.parse_crash("1@400:1200")
+    assert c == Crash(node=1, at=400.0, restart=1200.0)
+    assert FaultPlan.parse_crash("2@300").restart is None
+    p = FaultPlan.parse_partition("0,1|2,3@300:900")
+    assert p.side_a == frozenset({0, 1}) and p.side_b == frozenset({2, 3})
+    assert (p.at, p.heal) == (300.0, 900.0)
+    assert FaultPlan.parse_partition("0|1@5").heal is None
+    with pytest.raises(ValueError):
+        FaultPlan.parse_crash("nope")
+    with pytest.raises(ValueError):
+        FaultPlan.parse_partition("0,1@300")
+
+
+def test_schedule_queries():
+    plan = FaultPlan(
+        crashes=(Crash(node=1, at=10, restart=20),),
+        partitions=(Partition(frozenset({0}), frozenset({2}), at=5, heal=15),),
+    )
+    assert not plan.crashed(1, 9)
+    assert plan.crashed(1, 10) and plan.crashed(1, 19.9)
+    assert not plan.crashed(1, 20)
+    assert plan.partitioned(0, 2, 5) and plan.partitioned(2, 0, 14)
+    assert not plan.partitioned(0, 2, 15)
+    assert not plan.partitioned(0, 1, 10)  # node 1 is on neither side
+    assert plan.next_boundary(0) == 5
+    assert plan.next_boundary(10) == 15
+    assert plan.next_boundary(20) is None
+
+
+def test_native_engine_rejects_inexpressible_plans():
+    """The C++ engine cannot express drop/delay/partitions/restart; a chaos
+    run that silently skipped its faults would certify a recovery path
+    that was never exercised."""
+    from lachain_tpu.consensus.native_rt import load_rt
+
+    try:
+        load_rt()
+    except Exception:
+        pytest.skip("native engine not built")
+    with pytest.raises(ValueError, match="drop"):
+        Devnet(n=4, f=1, engine="native", fault_plan=FaultPlan(drop=0.1))
+    # expressible subset maps cleanly
+    d = Devnet(
+        n=4,
+        f=1,
+        engine="native",
+        fault_plan=FaultPlan(seed=3, duplicate=0.02, reorder=0.5),
+    )
+    d.run_eras(1, 1)
+    assert [d.height(i) for i in range(4)] == [1, 1, 1, 1]
