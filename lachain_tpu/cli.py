@@ -29,6 +29,16 @@ composition):
       recovery report. Same seed -> same faults -> same chain, so a
       production failure replays from its seed (DEPLOY.md, Failure
       handling).
+  lachain-tpu chaos --crash-point block.persist.mid
+      storage crash scenario: a child process runs the deterministic
+      commit workload and is SIGKILLed at the named pipeline point; the
+      parent fscks the torn database, repairs, and verifies a resumed run
+      completes (DEPLOY.md, Crash recovery).
+  lachain-tpu fsck --config netdir/config0.json [--deep] [--no-repair]
+      storage invariant scan: detects torn states (orphan block, lost
+      state roots, stale journal eras), repairs what is safely repairable.
+      Exit 0 = clean or repaired; 1 = refused (operator runbook in
+      DEPLOY.md); 2 = no database.
 """
 from __future__ import annotations
 
@@ -453,6 +463,10 @@ def cmd_chaos(args) -> int:
     from .network.faults import FaultPlan
     from .utils import metrics
 
+    if args.crash_point:
+        # storage crash scenario: orthogonal to the network fault plan (a
+        # SIGKILLed child + fsck + resume, not an in-process devnet)
+        return _run_crash_point_scenario(args)
     plan = FaultPlan(
         seed=args.seed,
         drop=args.drop,
@@ -590,6 +604,107 @@ def cmd_db(args) -> int:
         print(
             json.dumps({"rolledBackFrom": old, "height": height})
         )
+    return 0
+
+
+def cmd_fsck(args) -> int:
+    """Storage invariant scan (storage/fsck.py): the standalone verb for
+    what the node runs on every open. Exit codes: 0 clean-or-repaired,
+    1 refused (fatal issues — see the DEPLOY.md runbook), 2 no database."""
+    from .core.config import NodeConfig
+    from .storage.fsck import fsck
+    from .storage.kv import SqliteKV
+    from .storage.lsm import LsmKV
+
+    cfg = NodeConfig.load(args.config)
+    db_path = cfg.storage_path or (
+        os.path.splitext(args.config)[0] + ".db"
+    )
+    if not os.path.exists(db_path):
+        print(f"no database at {db_path}", file=sys.stderr)
+        return 2
+    kv = (LsmKV if cfg.storage_engine == "lsm" else SqliteKV)(db_path)
+    try:
+        report = fsck(kv, repair=not args.no_repair, deep=args.deep)
+    finally:
+        kv.close()
+    print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    return 1 if report.fatal else 0
+
+
+def _run_crash_point_scenario(args) -> int:
+    """chaos --crash-point: SIGKILL a real child process at a named storage
+    pipeline point, then prove the recovery story — fsck detects/repairs
+    the torn state and a resumed run completes. Repeating the same spec is
+    deterministic: the report prints the final chain height both times."""
+    import subprocess
+    import tempfile
+
+    from .storage import crash_workload, crashpoints
+    from .storage.fsck import fsck
+
+    specs = []
+    for spec in args.crash_point:
+        point = crashpoints.CrashPlan.parse_point(spec)
+        # the child must genuinely die: force sigkill mode
+        specs.append(
+            crashpoints.CrashPoint(
+                name=point.name, hit=point.hit, mode=crashpoints.MODE_SIGKILL
+            )
+        )
+    plan = crashpoints.CrashPlan(points=tuple(specs))
+    print(f"chaos crash-point: plan={plan.encode_env()} engine={args.engine}")
+    failures = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        db_path = os.path.join(tmp, "chaos.db")
+        env = dict(os.environ)
+        env[crashpoints.ENV_VAR] = plan.encode_env()
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        child = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "lachain_tpu.storage.crash_workload",
+                db_path,
+                args.engine,
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+        )
+        killed = child.returncode == -signal.SIGKILL
+        print(
+            f"child: rc={child.returncode} "
+            f"({'SIGKILLed at plan point' if killed else 'ran to completion'})"
+        )
+        if not killed:
+            print(
+                "crash point never fired — the workload does not traverse "
+                f"{[p.name for p in plan.points]}",
+                file=sys.stderr,
+            )
+            return 1
+        kv = crash_workload.open_kv(db_path, args.engine)
+        try:
+            report = fsck(kv, repair=True)
+            print("fsck:", json.dumps(report.to_dict(), sort_keys=True))
+            if report.fatal:
+                failures += 1
+            recheck = fsck(kv, repair=False)
+            if recheck.fatal:
+                print("fsck recheck still fatal after repair", file=sys.stderr)
+                failures += 1
+            # resume: the workload continues from the committed tip
+            stats = crash_workload.run_workload(kv)
+            print("resumed run:", json.dumps(stats, sort_keys=True))
+            if stats["height"] != crash_workload.DEFAULT_BLOCKS:
+                failures += 1
+        finally:
+            kv.close()
+    if failures:
+        print("CHAOS CRASH-POINT RUN FAILED", file=sys.stderr)
+        return 1
+    print("ok: crashed, repaired, resumed")
     return 0
 
 
@@ -734,11 +849,33 @@ def main(argv=None) -> int:
                     metavar="A,B|C,D@AT[:HEAL]",
                     help="partition schedule, repeatable "
                          "(e.g. '0,1|2,3@30:500')")
-    ch.add_argument("--engine", choices=["python", "native"],
-                    default="python")
+    ch.add_argument("--engine", choices=["python", "native", "sqlite", "lsm"],
+                    default="python",
+                    help="consensus engine for fault runs; storage engine "
+                         "(sqlite|lsm) for --crash-point runs")
+    ch.add_argument("--crash-point", action="append", default=[],
+                    metavar="NAME[@HIT]",
+                    help="storage crash scenario: SIGKILL a child workload "
+                         "at this pipeline point (see storage/crashpoints.py"
+                         " for names), then fsck + resume; repeatable")
     ch.set_defaults(fn=cmd_chaos)
 
+    fs = sub.add_parser(
+        "fsck", help="scan storage invariants; repair or refuse"
+    )
+    fs.add_argument("--config", required=True)
+    fs.add_argument("--deep", action="store_true",
+                    help="full trie DFS + full index scans (slow)")
+    fs.add_argument("--no-repair", action="store_true",
+                    help="report only; repairable issues become fatal")
+    fs.set_defaults(fn=cmd_fsck)
+
     args = p.parse_args(argv)
+    # subprocess crash harness: a child `lachain-tpu run` executes the
+    # parent's CrashPlan (no-op unless LACHAIN_CRASH_POINTS is set)
+    from .storage.crashpoints import arm_from_env
+
+    arm_from_env()
     return args.fn(args)
 
 

@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import logging
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import messages as M
+from .journal import send_slot
 from .binary_agreement import BinaryAgreement
 from .binary_broadcast import BinaryBroadcast
 from .common_coin import CommonCoin
@@ -42,9 +43,14 @@ class EraRouter(Broadcaster):
         private_keys: PrivateConsensusKeys,
         send: Callable[[Optional[int], Any], None],
         extra_factories: Optional[Dict[type, Callable]] = None,
+        journal=None,
     ):
         """`send(target, payload)`: target None = broadcast to all validators
-        (including self-delivery handled by the transport)."""
+        (including self-delivery handled by the transport). `journal` is an
+        optional ConsensusJournal: every outbound payload is durably
+        recorded BEFORE transmission, and re-derived values for a slot
+        already sent pre-crash are substituted with the recorded bytes
+        (crash-recovery no-self-equivocation, journal.py docstring)."""
         self.era = era
         self._my_id = my_id
         self.public_keys = public_keys
@@ -67,6 +73,13 @@ class EraRouter(Broadcaster):
         # Finished eras are pruned with the protocol GC in advance_era.
         self._outbox: Dict[int, deque] = {}
         self.outbox_cap = 4096  # entries per era; oldest evicted first
+        # durable-send latches: (era, slot) -> recorded wire bytes. A slot
+        # present here was already sent (this run or pre-crash via
+        # rearm_sent); any later send for it re-uses the recorded bytes so
+        # a restarted node cannot contradict its pre-crash self. Pruned
+        # with the protocol GC.
+        self._journal = journal
+        self._sent_slots: Dict[Tuple[int, tuple], bytes] = {}
 
     # -- Broadcaster interface ----------------------------------------------
     @property
@@ -82,12 +95,68 @@ class EraRouter(Broadcaster):
         return self.public_keys.f
 
     def broadcast(self, payload) -> None:
+        payload = self._durable_send(None, payload)
         self._record_outbox(None, payload)
         self._send(None, payload)
 
     def send_to(self, validator: int, payload) -> None:
+        payload = self._durable_send(validator, payload)
         self._record_outbox(validator, payload)
         self._send(validator, payload)
+
+    # -- durable sends (crash-recovery journal) -------------------------------
+    def _payload_era(self, payload) -> int:
+        try:
+            return getattr(M.payload_protocol_id(payload), "era", self.era)
+        except TypeError:
+            return self.era
+
+    def _durable_send(self, target: Optional[int], payload):
+        """Persist-before-transmit. Substitution happens BEFORE the outbox
+        record and before the transport's self-delivery, so the node's own
+        protocol state is rebuilt from exactly the bytes its peers saw
+        pre-crash — not from a freshly re-derived value."""
+        if self._journal is None:
+            return payload
+        from ..network import wire
+
+        slot = send_slot(payload)
+        era = self._payload_era(payload)
+        if slot is not None:
+            recorded = self._sent_slots.get((era, slot))
+            if recorded is not None:
+                # slot already durably sent: replay the recorded bytes
+                # byte-identically, never the re-derived value
+                from ..utils import metrics
+
+                metrics.inc("consensus_journal_replayed_sends_total")
+                return wire.decode_payload(recorded)
+        data = wire.encode_payload(payload)
+        self._journal.record(era, target, data)
+        if slot is not None:
+            self._sent_slots[(era, slot)] = data
+        return payload
+
+    def rearm_sent(self, era: int, target: Optional[int], data: bytes) -> None:
+        """Recovery path: re-arm the sent-latch and re-seed the outbox from
+        one journaled record (already durable — NOT re-journaled, NOT
+        re-transmitted here; retransmission is peer-pulled via
+        message_request / stall escalation)."""
+        from ..network import wire
+
+        try:
+            payload = wire.decode_payload(data)
+        except Exception:
+            logger.warning("undecodable journal entry for era %d", era)
+            return
+        slot = send_slot(payload)
+        if slot is not None and (era, slot) not in self._sent_slots:
+            self._sent_slots[(era, slot)] = data
+        q = self._outbox.get(era)
+        if q is None:
+            q = self._outbox[era] = deque()
+        if len(q) < self.outbox_cap:
+            q.append((target, payload))
 
     # -- retransmission outbox ------------------------------------------------
     def _record_outbox(self, target: Optional[int], payload) -> None:
@@ -198,6 +267,10 @@ class EraRouter(Broadcaster):
         # are settled on-chain and recoverable by block sync instead
         for e in [e for e in self._outbox if e < cutoff]:
             del self._outbox[e]
+        for key in [k for k in self._sent_slots if k[0] < cutoff]:
+            del self._sent_slots[key]
+        if self._journal is not None:
+            self._journal.prune_below(cutoff)
         pending, self._postponed = self._postponed, []
         self._postponed_per_sender = {}
         for sender, payload in pending:

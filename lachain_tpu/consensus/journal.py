@@ -1,0 +1,147 @@
+"""Durable consensus send journal: persist-before-transmit.
+
+Crash-recovery BFT must persist what it sent BEFORE transmitting, or a
+restarted validator can equivocate against its pre-crash self (Miller et
+al. 2016 §4.2 operates under a crash-fault model for honest nodes; the
+discipline is Raft's persist-before-respond rule applied to consensus
+sends). The exposure is concrete: BA AUX/CONF values and the signed block
+header depend on message ARRIVAL ORDER, so a mid-era restart that re-runs
+the era from scratch can legitimately derive a DIFFERENT value for a slot
+it already voted on — and two signed values for one slot is Byzantine
+behavior that honest peers will use against us.
+
+This journal records every outbound consensus payload (era, target, wire
+bytes) under the ``EntryPrefix.CONSENSUS_STATE`` keyspace, written through
+the KV's batched fsynced path before the payload reaches the transport.
+On restart the node replays it to:
+
+  * re-arm the era router's "already sent" latches — when the re-run era
+    reaches the same decision point again, the RECORDED bytes are re-sent,
+    byte-identical, never a re-derived value;
+  * re-seed the PR-2 retransmission outbox, so peers' ``message_request``s
+    are served across the restart;
+  * discover which eras were in flight, to rejoin them via
+    ``message_request``.
+
+Entries are pruned with the protocol GC (EraRouter.advance_era): an era
+settled on-chain no longer needs its sends — recovery for peers is block
+sync, not replay.
+
+Key layout: ``CONSENSUS_STATE | era u64 | seq u64`` ->
+``i64(target, -1 = broadcast) | bytes(payload wire bytes)``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from ..storage.kv import EntryPrefix, KVStore, prefixed
+from ..utils import metrics
+from ..utils.serialization import Reader, write_bytes, write_i64, write_u64
+
+from . import messages as M
+
+_PREFIX = prefixed(EntryPrefix.CONSENSUS_STATE)
+
+
+def send_slot(payload) -> Optional[tuple]:
+    """The per-era decision slot a payload occupies — the unit of
+    "already sent": one durable value per slot, re-sends must be
+    byte-identical. The slot key identifies the decision point, NOT the
+    value, except where the protocol legitimately sends both values
+    (BVAL: a node may broadcast BVAL(0) and BVAL(1) in one epoch after
+    seeing f+1 of the other — that is not equivocation, so the value is
+    part of the slot). Returns None for unlatchable payloads (journaled,
+    never substituted)."""
+    if isinstance(payload, M.ValMessage):
+        # one VAL per recipient shard (the sender's proposal commitment)
+        return ("val", payload.rbc, payload.shard_index)
+    if isinstance(payload, M.EchoMessage):
+        return ("echo", payload.rbc)
+    if isinstance(payload, M.ReadyMessage):
+        return ("ready", payload.rbc)
+    if isinstance(payload, M.BValMessage):
+        return ("bval", payload.bb, payload.value)
+    if isinstance(payload, M.AuxMessage):
+        return ("aux", payload.bb)
+    if isinstance(payload, M.ConfMessage):
+        return ("conf", payload.bb)
+    if isinstance(payload, M.CoinMessage):
+        return ("coin", payload.coin)
+    if isinstance(payload, M.DecryptedMessage):
+        return ("dec", payload.hb, payload.share_id)
+    if isinstance(payload, M.SignedHeaderMessage):
+        # the big one: two signed headers for one era is classic equivocation
+        return ("hdr", payload.root)
+    return None
+
+
+class ConsensusJournal:
+    """Append-only send journal over the node's KV store.
+
+    Writes ride ``write_batch`` — the KV's fsynced path — so a record is
+    durable before the send it covers leaves the node. Sequence numbers
+    are per-era and continue across restarts (seeded from a prefix scan at
+    construction), so replayed entries keep their original send order.
+    """
+
+    def __init__(self, kv: KVStore):
+        self._kv = kv
+        self._next_seq: Dict[int, int] = {}
+        for era, seq, _target, _data in self.entries():
+            if seq >= self._next_seq.get(era, 0):
+                self._next_seq[era] = seq + 1
+
+    def record(self, era: int, target: Optional[int], payload_bytes: bytes) -> None:
+        """Durably append one send BEFORE it is transmitted."""
+        seq = self._next_seq.get(era, 0)
+        key = _PREFIX + write_u64(era) + write_u64(seq)
+        value = write_i64(-1 if target is None else target) + write_bytes(
+            payload_bytes
+        )
+        self._kv.write_batch([(key, value)])
+        self._next_seq[era] = seq + 1
+        metrics.inc("consensus_journal_records_total")
+
+    def entries(self) -> Iterator[Tuple[int, int, Optional[int], bytes]]:
+        """Yield (era, seq, target, payload_bytes) in (era, seq) order.
+        Undecodable values are skipped (reported by fsck, repaired there)."""
+        for key, value in self._kv.scan_prefix(_PREFIX):
+            tail = key[len(_PREFIX):]
+            if len(tail) != 16:
+                continue
+            era = int.from_bytes(tail[:8], "big")
+            seq = int.from_bytes(tail[8:], "big")
+            try:
+                r = Reader(value)
+                target = r.i64()
+                data = r.bytes_()
+            except Exception:
+                continue
+            yield era, seq, (None if target < 0 else target), data
+
+    def eras(self) -> list:
+        """Distinct eras with journaled sends, ascending."""
+        out = set()
+        for era, _seq, _target, _data in self.entries():
+            out.add(era)
+        return sorted(out)
+
+    def prune_below(self, era_cutoff: int) -> int:
+        """Drop entries for eras < `era_cutoff` (the protocol-GC retention:
+        settled eras recover by block sync, not replay). One batched
+        delete; returns the number of entries dropped."""
+        doomed = [
+            key
+            for key, _ in self._kv.scan_prefix(_PREFIX)
+            if len(key) == len(_PREFIX) + 16
+            and int.from_bytes(key[len(_PREFIX):len(_PREFIX) + 8], "big")
+            < era_cutoff
+        ]
+        if doomed:
+            self._kv.write_batch([], doomed)
+            for era in [
+                e for e in self._next_seq if e < era_cutoff
+            ]:
+                del self._next_seq[era]
+            metrics.inc("consensus_journal_pruned_total", len(doomed))
+        return len(doomed)

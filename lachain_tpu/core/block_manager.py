@@ -124,6 +124,9 @@ class BlockManager:
         return block
 
     def _persist(self, block: Block, txs, em: EmulationResult) -> None:
+        from ..storage.crashpoints import crash_point
+
+        crash_point("block.persist.pre")
         h = block.hash()
         puts = [
             (prefixed(EntryPrefix.BLOCK_BY_HASH, h), block.encode()),
@@ -182,7 +185,12 @@ class BlockManager:
             )
         )
         self._kv.write_batch(puts)
+        # the torn-block window: the block batch is durable but the state
+        # commit (trie nodes + snapshot index + tip) is not — a crash here
+        # leaves an orphan block above the tip, which fsck must detect
+        crash_point("block.persist.mid")
         self.state.commit(block.header.index, em.roots)
+        crash_point("block.persist.post")
         for cb in list(self.on_block_persisted):
             cb(block)
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import copy
 import json
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 CURRENT_VERSION = 6
 
@@ -140,9 +140,11 @@ class NetworkSection:
     # the address OTHER nodes should dial (defaults to host; set when
     # binding a wildcard or behind NAT in multi-host deployments)
     advertise_host: Optional[str] = None
-    # "host:port:pubhex" of a public relay — NAT'd nodes with no dialable
-    # address participate through it (reference Hub relay bootstrap)
-    relay: Optional[str] = None
+    # public relay(s) — NAT'd nodes with no dialable address participate
+    # through one (reference Hub relay bootstrap). A single "host:port:pubhex"
+    # string or a LIST of them: the node registers with the first and fails
+    # over down the list when its relay stops answering (relay HA)
+    relay: Optional[Union[str, List[str]]] = None
     # peers: list of "host:port:pubkeyhex"
     peers: List[str] = field(default_factory=list)
 

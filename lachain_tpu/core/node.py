@@ -66,13 +66,23 @@ class Node:
         wallet: Optional[PrivateWallet] = None,
         block_interval: float = 0.0,
         advertise_host: Optional[str] = None,
-        relay: Optional[str] = None,  # "host:port:pubhex" — NAT'd mode
+        relay=None,  # "host:port:pubhex" or a list of them — NAT'd mode
     ):
         self.index = index
         self.public_keys = public_keys
         self.private_keys = private_keys
         self.chain_id = chain_id
         self.kv = kv if kv is not None else MemoryKV()
+        # invariant scan BEFORE any subsystem reads the db: repairs the
+        # safely-repairable torn states a crash can leave (orphan block
+        # above tip, stale journal eras, undecodable pool rows) and
+        # REFUSES to run on anything else — FsckError carries the report
+        # (storage/fsck.py; DEPLOY.md "Crash recovery")
+        from ..storage.fsck import FsckError, fsck
+
+        self.fsck_report = fsck(self.kv, repair=True)
+        if self.fsck_report.fatal:
+            raise FsckError(self.fsck_report)
         self.state = StateManager(self.kv)
         from . import system_contracts
 
@@ -89,6 +99,18 @@ class Node:
         self.pool = TransactionPool(
             self.kv, chain_id, account_nonce=self._account_nonce
         )
+        # crash-restore: repopulate from the persisted pool repository (the
+        # repository existed but was never replayed on open — a restart
+        # silently lost every pending tx)
+        restored = self.pool.restore()
+        if restored:
+            logger.info("restored %d pooled txs from disk", restored)
+        # durable consensus send journal (consensus/journal.py): recovery
+        # state re-armed in start(), rejoin requests sent in connect()
+        from ..consensus.journal import ConsensusJournal
+
+        self.journal = ConsensusJournal(self.kv)
+        self._rejoin_eras: List[int] = []
         self.producer = BlockProducer(
             self.block_manager,
             self.pool,
@@ -205,25 +227,58 @@ class Node:
         await self.network.start()
         if self._relay_spec:
             # NAT'd mode (reference HubConnector bootstrap): register with
-            # the configured relay; our gossip address becomes the relay
-            # sentinel so peers route to us through it
+            # the configured relay(s); our gossip address becomes the relay
+            # sentinel so peers route to us through it. A list enables
+            # failover to the next relay when the current one goes dark.
             from ..network.hub import PeerAddress as _PA
 
-            rhost, rport, rpub = self._relay_spec.rsplit(":", 2)
-            self.network.use_relay(
-                _PA(
-                    public_key=bytes.fromhex(rpub),
-                    host=rhost,
-                    port=int(rport),
-                )
+            specs = (
+                self._relay_spec
+                if isinstance(self._relay_spec, (list, tuple))
+                else [self._relay_spec]
             )
+            relays = []
+            for spec in specs:
+                rhost, rport, rpub = spec.rsplit(":", 2)
+                relays.append(
+                    _PA(
+                        public_key=bytes.fromhex(rpub),
+                        host=rhost,
+                        port=int(rport),
+                    )
+                )
+            self.network.use_relay(relays)
         # the router exists before the era loop runs so consensus traffic
         # from faster peers is dispatched (or era-buffered), not dropped
         # (observers — index < 0 — only sync, never vote)
         if self.index >= 0:
             self._ensure_router(first_era)
+            self._recover_journal()
         if start_synchronizer:
             self.start_services()
+
+    def _recover_journal(self) -> None:
+        """Crash-recovery replay (journal.py docstring): prune entries for
+        eras already settled on-chain, re-arm the router's sent-latches and
+        outbox from what remains, and remember the in-flight eras so
+        connect() can rejoin them via message_request. Nothing is
+        transmitted here — no peer workers exist yet."""
+        assert self.router is not None
+        height = self.block_manager.current_height()
+        self.journal.prune_below(height + 1)
+        eras = set()
+        n = 0
+        for era, _seq, target, data in self.journal.entries():
+            self.router.rearm_sent(era, target, data)
+            eras.add(era)
+            n += 1
+        self._rejoin_eras = sorted(eras)
+        if n:
+            logger.info(
+                "journal recovery: re-armed %d sends across eras %s",
+                n,
+                self._rejoin_eras,
+            )
 
     def start_services(self) -> None:
         self.synchronizer.start()
@@ -366,6 +421,19 @@ class Node:
     def connect(self, peers: List[PeerAddress]) -> None:
         for p in peers:
             self.network.add_peer(p)
+        if self._rejoin_eras:
+            # restart rejoin: ask every peer to replay the traffic of the
+            # eras we were mid-flight in when we died (the watchdog's
+            # escalation ladder is the backstop if this first ask is lost)
+            from ..utils import metrics
+
+            for era in self._rejoin_eras:
+                self.network.broadcast(wire.message_request(era))
+            metrics.inc(
+                "consensus_rejoin_requests_total", len(self._rejoin_eras)
+            )
+            logger.info("rejoin: requested replay for eras %s", self._rejoin_eras)
+            self._rejoin_eras = []
 
     def _account_nonce(self, addr: bytes) -> int:
         return get_nonce(self.state.new_snapshot(), addr)
@@ -549,6 +617,7 @@ class Node:
                 self.private_keys,
                 self._transport_send,
                 extra_factories={M.RootProtocolId: self._root_factory},
+                journal=self.journal,
             )
         else:
             self.router.advance_era(era)

@@ -76,6 +76,12 @@ class TransactionPool:
             self._txs[h] = stx
             self._senders[h] = sender
             self._by_nonce[key] = h
+            # the pool's crash window: admitted to memory, not yet in the
+            # crash-restore repository — a kill here loses the tx from the
+            # restart (best-effort by design; gossip re-fills)
+            from ..storage.crashpoints import crash_point
+
+            crash_point("pool.save.mid")
             self._kv.put(prefixed(EntryPrefix.POOL_TX, h), stx.encode())
             return True
 

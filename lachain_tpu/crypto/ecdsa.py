@@ -262,20 +262,29 @@ def ecdh_shared_secret(priv: bytes, pub: bytes) -> bytes:
 
 def aes_gcm_encrypt(key: bytes, plaintext: bytes) -> bytes:
     """nonce(12) || ciphertext+tag (reference: DefaultCrypto.AesGcmEncrypt,
-    DefaultCrypto.cs:267-283)."""
+    DefaultCrypto.cs:267-283). Falls back to the pure-Python GCM when the
+    `cryptography` package is absent — same wire format either way."""
     import secrets as _secrets
 
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
     nonce = _secrets.token_bytes(12)
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError:
+        from . import _aes_fallback
+
+        return nonce + _aes_fallback.encrypt(key, nonce, plaintext)
     return nonce + AESGCM(key).encrypt(nonce, plaintext, None)
 
 
 def aes_gcm_decrypt(key: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
     if len(data) < 12 + 16:
         raise ValueError("AES-GCM payload too short")
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError:
+        from . import _aes_fallback
+
+        return _aes_fallback.decrypt(key, data[:12], data[12:])
     return AESGCM(key).decrypt(data[:12], data[12:], None)
 
 

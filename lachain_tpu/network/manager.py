@@ -72,8 +72,13 @@ class NetworkManager:
         self.relay_clients: Dict[bytes, float] = {}   # pub -> last seen
         self._last_conn: Dict[bytes, int] = {}        # pub -> conn id
         self._relay_client_ttl = 90.0
-        # as a NAT'D NODE: the relay we registered with (None = direct)
+        # as a NAT'D NODE: the relay we registered with (None = direct),
+        # plus the configured fallback list for relay HA: when the current
+        # relay stops answering, registration fails over down the list
         self._my_relay: Optional[PeerAddress] = None
+        self._relays: List[PeerAddress] = []
+        self._relay_idx = 0
+        self.relay_failover_after = 3  # consecutive send failures
         self._reregister_task = None
         # as a SENDER: peers reachable only through a relay
         self._relay_route: Dict[bytes, bytes] = {}    # peer pub -> relay pub
@@ -93,18 +98,31 @@ class NetworkManager:
 
     # -- relay / NAT traversal ---------------------------------------------
 
-    def use_relay(self, relay: PeerAddress, reregister_every: float = 20.0) -> None:
-        """NAT'd mode: register with `relay` and advertise ourselves as
+    def use_relay(self, relay, reregister_every: float = 20.0) -> None:
+        """NAT'd mode: register with a relay and advertise ourselves as
         reachable through it. The registration re-sends periodically —
-        it refreshes the relay's TTL and keeps the NAT mapping warm."""
-        self._my_relay = relay
-        self.add_peer(relay, authoritative=True)
-        self.send_to(relay.public_key, wire.relay_register())
+        it refreshes the relay's TTL and keeps the NAT mapping warm.
+
+        `relay` is one PeerAddress or a LIST of them (relay HA): the node
+        registers with the first and, when that relay's worker accumulates
+        `relay_failover_after` consecutive send failures, rotates to the
+        next one and re-advertises the new route to every peer (the
+        self-declared address in a peers_request is authoritative, so the
+        rebind propagates without any relay cooperation)."""
+        self._relays = (
+            list(relay) if isinstance(relay, (list, tuple)) else [relay]
+        )
+        if not self._relays:
+            raise ValueError("use_relay: empty relay list")
+        self._relay_idx = 0
+        self._register_with(self._relays[0])
 
         async def rereg():
             while True:
                 await asyncio.sleep(reregister_every)
-                self.send_to(relay.public_key, wire.relay_register())
+                self._maybe_failover_relay()
+                assert self._my_relay is not None
+                self.send_to(self._my_relay.public_key, wire.relay_register())
 
         try:
             self._reregister_task = asyncio.get_running_loop().create_task(
@@ -119,6 +137,46 @@ class NetworkManager:
                 "re-registration NOT scheduled; caller must re-register"
             )
             metrics.inc("network_relay_reregister_skipped_total")
+
+    def _register_with(self, relay: PeerAddress) -> None:
+        self._my_relay = relay
+        self.add_peer(relay, authoritative=True)
+        self.send_to(relay.public_key, wire.relay_register())
+
+    def _maybe_failover_relay(self) -> None:
+        """Rotate to the next configured relay when the current one has
+        stopped accepting our traffic. The signal is the relay WORKER's
+        consecutive-failure counter — the same health signal that drives
+        its backoff — so a relay that merely drops reverse traffic but
+        still ACKs ours is out of scope (peers' message_request recovery
+        covers that loss)."""
+        if len(self._relays) < 2 or self._my_relay is None:
+            return
+        worker = self._workers.get(self._my_relay.public_key)
+        if (
+            worker is None
+            or worker.consecutive_failures < self.relay_failover_after
+        ):
+            return
+        self._relay_idx = (self._relay_idx + 1) % len(self._relays)
+        new = self._relays[self._relay_idx]
+        logger.warning(
+            "relay %s unresponsive (%d consecutive failures): failing over "
+            "to %s:%d",
+            self._my_relay.public_key.hex()[:16],
+            worker.consecutive_failures,
+            new.host,
+            new.port,
+        )
+        metrics.inc("network_relay_failovers_total")
+        self._register_with(new)
+        # our advertised address just changed (the relay sentinel embeds
+        # the relay's pubkey): push the rebind to every peer now — the
+        # self-declared address in a peers_request is authoritative
+        adv_host, adv_port = self.advertised_host_port
+        for pub, w in self._workers.items():
+            if pub != new.public_key:
+                w.enqueue(wire.peers_request(adv_host, adv_port))
 
     @property
     def advertised_host_port(self):

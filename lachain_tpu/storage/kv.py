@@ -139,6 +139,9 @@ class SqliteKV(KVStore):
             self._conn.commit()
 
     def write_batch(self, puts, deletes=()) -> None:
+        from .crashpoints import crash_point
+
+        crash_point("kv.write_batch.pre")
         with self._lock:
             # FULL for the batch commit: block persistence is exactly the
             # write that must survive power failure; the fsync cost is paid
@@ -154,6 +157,9 @@ class SqliteKV(KVStore):
                     cur.executemany(
                         "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
                     )
+                # mid = after the writes, before the fsynced commit: the
+                # window a kill -9 must roll back entirely
+                crash_point("kv.write_batch.mid")
                 self._conn.commit()
             except BaseException:
                 # a half-written batch must NOT linger in the open implicit
@@ -163,6 +169,7 @@ class SqliteKV(KVStore):
                 raise
             finally:
                 self._conn.execute("PRAGMA synchronous=NORMAL")
+        crash_point("kv.write_batch.post")
 
     def scan_prefix(self, prefix: bytes):
         hi = prefix + b"\xff" * 8

@@ -121,10 +121,16 @@ class LsmKV(KVStore):
     def write_batch(
         self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()
     ) -> None:
+        from .crashpoints import crash_point
+
+        crash_point("kv.write_batch.pre")
         payload = _encode_batch(list(puts), list(deletes))
         with self._lock:
             if self._lib.lsm_write_batch(self._h, payload, len(payload)) != 0:
                 raise IOError("LSM write_batch failed")
+        # no .mid point: the batch commits inside one native call — the
+        # torn-WAL-tail window is exercised by the engine's own crash test
+        crash_point("kv.write_batch.post")
 
     def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
         buf = ctypes.POINTER(ctypes.c_ubyte)()

@@ -22,6 +22,7 @@ import json
 import logging
 from typing import Optional
 
+from .crashpoints import crash_point
 from .kv import EntryPrefix, KVStore, prefixed
 from .state import StateManager, StateRoots
 from .trie import EMPTY_ROOT, InternalNode, LeafNode
@@ -91,6 +92,7 @@ class DbShrink:
                         progress["marked"] += self._mark_roots(roots)
                     progress["next_height"] = height + 1
                     self._save_progress(progress)  # per-height resume point
+                    crash_point("shrink.mark.height")
                 # Re-check the tip before committing to sweep: marking takes
                 # real time, and a block committed meanwhile (threaded caller,
                 # CLI racing a live node) would have its nodes swept as
@@ -107,12 +109,14 @@ class DbShrink:
             self._save_progress(progress)
 
         if progress["stage"] == "sweep":
+            crash_point("shrink.sweep.pre")
             swept = self._sweep(progress)
             progress["swept"] = progress.get("swept", 0) + swept
             progress["stage"] = "clean"
             self._save_progress(progress)
 
         if progress["stage"] == "clean":
+            crash_point("shrink.clean.pre")
             self._clean_marks()
             # drop pruned heights from the snapshot index: scan live index
             # rows (O(retained) after the first shrink) instead of probing

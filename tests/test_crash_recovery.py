@@ -1,0 +1,358 @@
+"""Crash-restart durability of the consensus layer.
+
+The dangerous restart failure is SELF-EQUIVOCATION: AUX/CONF/coin values
+depend on message arrival order, so a restarted validator that re-derives
+them can legitimately compute DIFFERENT values than it already sent — and
+two signed values for one slot is Byzantine behaviour the protocol punishes.
+The journal (consensus/journal.py) fixes this by persist-before-transmit +
+replay of the RECORDED bytes, never re-derivation. These tests prove it at
+the router level (byte-identity under adversarial re-delivery), at the node
+level (in-process restart mid-era), and end to end (real SIGKILL of a
+devnet process, restart, bit-identical state roots).
+"""
+import asyncio
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from lachain_tpu.consensus import messages as M
+from lachain_tpu.consensus.era import EraRouter
+from lachain_tpu.consensus.journal import ConsensusJournal, send_slot
+from lachain_tpu.consensus.keys import trusted_key_gen
+from lachain_tpu.consensus.simulator import DeliveryMode, SimulatedNetwork
+from lachain_tpu.network import wire
+from lachain_tpu.storage.kv import MemoryKV
+from lachain_tpu.utils import metrics
+
+pytestmark = pytest.mark.crash
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def test_journal_replay_no_equivocation():
+    """Router-level acceptance: restart a validator from its journal, feed
+    it its run-1 inbox in a DIFFERENT adversarial order AND a different
+    top-level input — every latched slot it re-sends must be byte-identical
+    to what it sent before the crash."""
+    n, f = 4, 1
+    pub, privs = trusted_key_gen(n, f, rng=Rng(17))
+    kvs = [MemoryKV() for _ in range(n)]
+    journals = [ConsensusJournal(kv) for kv in kvs]
+    inboxes = [[] for _ in range(n)]
+
+    class RecordingRouter(EraRouter):
+        def dispatch_external(self, sender, payload):
+            inboxes[self.my_id].append((sender, payload))
+            super().dispatch_external(sender, payload)
+
+    def router_cls(**kw):
+        return RecordingRouter(journal=journals[kw["my_id"]], **kw)
+
+    net = SimulatedNetwork(
+        pub,
+        privs,
+        seed=5,
+        mode=DeliveryMode.TAKE_RANDOM,
+        router_cls=router_cls,
+    )
+    pid = M.HoneyBadgerId(era=0)
+    for i in range(n):
+        net.post_request(i, pid, b"tx-%d|" % i + bytes(16))
+    assert net.run(
+        lambda: all(r.result_of(pid) is not None for r in net.routers)
+    )
+
+    # run-1 ground truth for validator 0: recorded wire bytes per slot
+    recorded = {}
+    for era, _seq, _target, data in journals[0].entries():
+        slot = send_slot(wire.decode_payload(data))
+        if slot is not None:
+            assert (era, slot) not in recorded, "slot journaled twice"
+            recorded[(era, slot)] = data
+    assert len(recorded) >= 10, "era produced too few latched sends"
+
+    # "restart": a FRESH router over the same journal
+    resent = []
+    r2 = EraRouter(
+        era=0,
+        my_id=0,
+        public_keys=pub,
+        private_keys=privs[0],
+        send=lambda t, p: resent.append(p),
+    )
+    r2._journal = journals[0]
+    before = metrics.counter_value("consensus_journal_replayed_sends_total")
+    for era, _seq, target, data in journals[0].entries():
+        r2.rearm_sent(era, target, data)
+    # the outbox was re-seeded: peers asking for replay get the history
+    assert r2.replay_outbox(0, 1) > 0
+
+    # adversarial re-run: different input, shuffled inbox
+    r2.internal_request(
+        M.Request(from_id=None, to_id=pid, input=b"DIFFERENT-BATCH")
+    )
+    inbox = list(inboxes[0])
+    random.Random(99).shuffle(inbox)
+    for sender, payload in inbox:
+        r2.dispatch_external(sender, payload)
+
+    checked = 0
+    for payload in resent:
+        slot = send_slot(payload)
+        if slot is None:
+            continue
+        key = (r2._payload_era(payload), slot)
+        if key in recorded:
+            assert wire.encode_payload(payload) == recorded[key], (
+                f"self-equivocation on slot {key}"
+            )
+            checked += 1
+    assert checked >= 5, "replay never exercised the latches"
+    after = metrics.counter_value("consensus_journal_replayed_sends_total")
+    assert after > before, "no send was substituted from the journal"
+
+
+def _free_ports_env():
+    return dict(os.environ, JAX_PLATFORMS="cpu", LOG_LEVEL="WARNING")
+
+
+def test_node_restart_recovers_journal_and_rejoins(tmp_path):
+    """In-process restart: validator 3 dies mid-era (after journaling
+    sends, before the block lands), comes back over the SAME database, and
+    the recovered node (a) re-arms its sent-latches, (b) queues the era for
+    rejoin, (c) finishes the era with the state root everyone else got."""
+    from lachain_tpu.core.node import Node
+    from lachain_tpu.crypto import ecdsa
+    from lachain_tpu.storage.kv import SqliteKV
+
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(23))
+    addrs = [ecdsa.address_from_public_key(pk) for pk in pub.ecdsa_pub_keys]
+    balances = {a: 10**21 for a in addrs}
+    db3 = str(tmp_path / "v3.db")
+
+    async def run():
+        def mk(i, kv=None):
+            return Node(
+                index=i,
+                public_keys=pub,
+                private_keys=privs[i],
+                chain_id=225,
+                kv=kv,
+                initial_balances=balances,
+                flush_interval=0.01,
+                txs_per_block=100,
+            )
+
+        nodes = [mk(i) for i in range(3)] + [mk(3, SqliteKV(db3))]
+        for nd in nodes:
+            await nd.start()
+        addrs_net = [nd.network.address for nd in nodes]
+        for i, nd in enumerate(nodes):
+            nd.connect([a for j, a in enumerate(addrs_net) if j != i])
+        try:
+            survivors = [
+                asyncio.ensure_future(nodes[i].run_era(1, timeout=60.0))
+                for i in range(3)
+            ]
+            victim = asyncio.ensure_future(nodes[3].run_era(1, timeout=60.0))
+            # let 3 participate until its journal holds real sends...
+            for _ in range(600):
+                await asyncio.sleep(0.01)
+                if sum(1 for _ in nodes[3].journal.entries()) >= 4:
+                    break
+            assert sum(1 for _ in nodes[3].journal.entries()) >= 4
+            # ...then kill it mid-era (block 1 must NOT be on its disk)
+            victim.cancel()
+            await nodes[3].stop()
+            assert nodes[3].block_manager.current_height() == 0
+            blocks = await asyncio.gather(*survivors)
+            assert len({b.header.state_hash for b in blocks}) == 1
+        finally:
+            nodes[3].kv.close()
+
+        # restart over the same database
+        node3b = mk(3, SqliteKV(db3))
+        await node3b.start()
+        try:
+            # (a) latches re-armed from the journal, (b) era queued
+            rearmed = dict(node3b.router._sent_slots)
+            assert rearmed, "journal recovery re-armed nothing"
+            assert node3b._rejoin_eras == [1]
+            before = metrics.counter_value("consensus_rejoin_requests_total")
+            node3b.connect(addrs_net[:3])
+            assert node3b._rejoin_eras == []  # flushed as message_requests
+            assert (
+                metrics.counter_value("consensus_rejoin_requests_total")
+                > before
+            )
+            block = await node3b.run_era(1, timeout=60.0)
+            # (c) same era outcome as the survivors, and every latched
+            # slot still carries its pre-crash bytes (no equivocation)
+            assert block.header.state_hash == blocks[0].header.state_hash
+            for slot, data in rearmed.items():
+                assert node3b.router._sent_slots[slot] == data
+            assert (
+                node3b.state.roots_at(1).encode()
+                == nodes[0].state.roots_at(1).encode()
+            )
+        finally:
+            await node3b.stop()
+            node3b.kv.close()
+            for nd in nodes[:3]:
+                await nd.stop()
+
+    asyncio.run(run())
+
+
+# -- end-to-end devnet: real SIGKILL, real restart --------------------------
+
+PORT_BASE = 7470
+CHAIN = 225
+
+
+def _rpc(port, method, *params, timeout=3):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+def _height(port):
+    return int(_rpc(port, "eth_blockNumber"), 16)
+
+
+@pytest.mark.slow
+def test_devnet_sigkill_restart_bit_identical_roots(tmp_path):
+    """Acceptance e2e: SIGKILL a real validator process mid-era, restart
+    it over its surviving database (fsck repairs any torn write on open,
+    the journal rejoins the era), and the chain keeps finalizing with
+    bit-identical state roots on all four nodes."""
+    from lachain_tpu.storage.kv import SqliteKV
+    from lachain_tpu.storage.state import StateManager
+
+    netdir = tmp_path / "net"
+    env = _free_ports_env()
+    subprocess.run(
+        [
+            sys.executable, "-m", "lachain_tpu.cli", "keygen",
+            "--n", "4", "--f", "1", "--out", str(netdir),
+            "--port-base", str(PORT_BASE),
+            "--block-time-ms", "200",
+        ],
+        check=True,
+        env=env,
+        timeout=120,
+    )
+
+    def launch(i):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "lachain_tpu.cli", "run",
+                "--config", str(netdir / f"config{i}.json"),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    procs = [launch(i) for i in range(4)]
+    rpc0 = PORT_BASE + 1
+    try:
+        # wait for real cross-process consensus
+        deadline = time.time() + 120
+        while time.time() < deadline and _try(_height, rpc0, default=-1) < 2:
+            time.sleep(0.5)
+        killed_at = _try(_height, rpc0, default=-1)
+        assert killed_at >= 2, "devnet never produced blocks"
+
+        # SIGKILL validator 3 — mid-era with near-certainty at a 200ms
+        # block time; no shutdown hooks run, the db is whatever it is
+        os.kill(procs[3].pid, signal.SIGKILL)
+        procs[3].wait(timeout=30)
+        assert procs[3].returncode == -signal.SIGKILL
+
+        # chain keeps finalizing without it (n=4 tolerates f=1)...
+        deadline = time.time() + 120
+        while (
+            time.time() < deadline
+            and _try(_height, rpc0, default=-1) < killed_at + 2
+        ):
+            time.sleep(0.5)
+        assert _try(_height, rpc0, default=-1) >= killed_at + 2
+
+        # ...and the restarted validator fscks, rejoins and catches up
+        procs[3] = launch(3)
+        target = _try(_height, rpc0, default=2) + 2
+        rpc3 = PORT_BASE + 2 * 3 + 1
+        deadline = time.time() + 180
+        while (
+            time.time() < deadline
+            and _try(_height, rpc3, default=-1) < target
+        ):
+            time.sleep(0.5)
+        assert _try(_height, rpc3, default=-1) >= target, (
+            "killed validator never caught back up"
+        )
+        common = min(
+            _height(PORT_BASE + 2 * i + 1) for i in range(4)
+        )
+        assert common >= target - 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    # offline: the state roots at every common height must be
+    # bit-identical across all four databases — including the node that
+    # died by SIGKILL and recovered
+    roots = []
+    for i in range(4):
+        kv = SqliteKV(str(netdir / f"config{i}.db"))
+        try:
+            st = StateManager(kv)
+            tip = st.committed_height()
+            roots.append(
+                {h: st.roots_at(h).encode() for h in range(1, common + 1)}
+            )
+            assert tip >= common
+        finally:
+            kv.close()
+    for h in range(1, common + 1):
+        assert len({r[h] for r in roots}) == 1, (
+            f"state root divergence at height {h}"
+        )
+
+
+def _try(fn, *args, default=None):
+    try:
+        return fn(*args)
+    except Exception:
+        return default

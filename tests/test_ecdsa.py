@@ -184,3 +184,70 @@ def test_warm_sender_caches():
         assert cached is not None and cached[0] == chain_id
         want = ecdsa.address_from_public_key(ecdsa.public_key_bytes(p))
         assert stx.sender(chain_id) == want
+
+
+def test_aes_gcm_fallback_nist_vectors():
+    """The pure-Python GCM (crypto/_aes_fallback.py) that backs
+    aes_gcm_encrypt when `cryptography` is absent must match NIST
+    SP 800-38D reference vectors bit for bit — otherwise wallets written
+    in one environment can't be read in the other."""
+    from lachain_tpu.crypto import _aes_fallback as f
+
+    assert (
+        f.encrypt(bytes(16), bytes(12), b"").hex()
+        == "58e2fccefa7e3061367f1d57a4e7455a"
+    )
+    assert f.encrypt(bytes(16), bytes(12), bytes(16)).hex() == (
+        "0388dace60b6a392f328c2b971b2fe78"
+        "ab6e47d42cec13bdf53a67b21257bddf"
+    )
+    key = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+    nonce = bytes.fromhex("cafebabefacedbaddecaf888")
+    pt = bytes.fromhex(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b391aafd255"
+    )
+    aad = bytes.fromhex("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+    out = f.encrypt(key, nonce, pt)
+    assert out[-16:].hex() == "4d5c2af327cd64a62cf35abd2ba6fab4"
+    assert (
+        f.encrypt(key, nonce, pt[:-4], aad)[-16:].hex()
+        == "5bc94fbc3221a5db94fae95ae7121a47"
+    )
+    assert f.encrypt(bytes(24), bytes(12), bytes(16)).hex() == (
+        "98e7247c07f0fe411c267e4384b0f600"
+        "2ff58d80033927ab8ef4d4587514f0fb"
+    )
+    assert f.encrypt(bytes(32), bytes(12), bytes(16)).hex() == (
+        "cea7403d4d606b6e074ec5d3baf39d18"
+        "d0d1c8a799996bf0265b98b5d48ab919"
+    )
+
+
+def test_aes_gcm_fallback_roundtrip_and_tamper():
+    import random as _random
+
+    from lachain_tpu.crypto import _aes_fallback as f
+
+    r = _random.Random(5)
+    key = bytes(r.getrandbits(8) for _ in range(32))
+    nonce = bytes(r.getrandbits(8) for _ in range(12))
+    msg = bytes(r.getrandbits(8) for _ in range(999))
+    ct = f.encrypt(key, nonce, msg, b"aad")
+    assert f.decrypt(key, nonce, ct, b"aad") == msg
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        f.decrypt(key, nonce, ct[:-1] + bytes([ct[-1] ^ 1]), b"aad")
+    with _pytest.raises(ValueError):
+        f.decrypt(key, nonce, ct, b"wrong-aad")
+
+
+def test_wallet_roundtrip_without_cryptography_package():
+    """aes_gcm_encrypt/decrypt (and thus PrivateWallet save/load and the
+    keygen->run CLI path) must work in containers without `cryptography`."""
+    from lachain_tpu.crypto import ecdsa
+
+    key = bytes(range(32))
+    blob = ecdsa.aes_gcm_encrypt(key, b"wallet-payload" * 20)
+    assert ecdsa.aes_gcm_decrypt(key, blob) == b"wallet-payload" * 20

@@ -1,0 +1,130 @@
+"""Relay HA (ROUND5 gap #5): a NAT'd node configured with a LIST of
+relays registers with the first, fails over to the next when it goes
+dark, and re-advertises the new route to its peers — the self-declared
+address in a peers_request is authoritative, so the rebind propagates
+without any relay cooperation."""
+import asyncio
+import random
+
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.network import wire
+from lachain_tpu.network.manager import NetworkManager
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _mgr(seed, **kw):
+    return NetworkManager(
+        ecdsa.generate_private_key(Rng(seed)), flush_interval=0.01, **kw
+    )
+
+
+def test_config_accepts_relay_list():
+    from lachain_tpu.core.config import NodeConfig
+
+    cfg = NodeConfig.from_dict(
+        {
+            "version": 6,
+            "network": {
+                "relay": ["h1:1:aa", "h2:2:bb"],
+            },
+        }
+    )
+    assert cfg.network.relay == ["h1:1:aa", "h2:2:bb"]
+    cfg = NodeConfig.from_dict(
+        {"version": 6, "network": {"relay": "h1:1:aa"}}
+    )
+    assert cfg.network.relay == "h1:1:aa"
+
+
+def test_relay_failover_and_readvertise():
+    """relay1 dies -> the NAT'd node rotates to relay2, registers there,
+    and pushes its new sentinel address to connected peers."""
+
+    async def run():
+        relay1, relay2 = _mgr(2), _mgr(3)
+        natd, peer = _mgr(4), _mgr(5)
+        for m in (relay1, relay2, natd, peer):
+            await m.start()
+        try:
+            # the peer must know both relays: a relay-routed advert for an
+            # unknown relay is dropped (Byzantine blackhole defense)
+            peer.add_peer(relay1.address)
+            peer.add_peer(relay2.address)
+            natd.use_relay(
+                [relay1.address, relay2.address], reregister_every=0.05
+            )
+            # the NAT'd node dials the peer; its peers_request carries the
+            # (relay1) sentinel address
+            natd.add_peer(peer.address, authoritative=True)
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if natd.public_key in relay1.relay_clients and (
+                    w := peer._workers.get(natd.public_key)
+                ):
+                    if w.peer.host == wire.relay_host(relay1.public_key):
+                        break
+            assert natd.public_key in relay1.relay_clients
+            assert (
+                peer._workers[natd.public_key].peer.host
+                == wire.relay_host(relay1.public_key)
+            )
+            assert natd._my_relay == relay1.address
+
+            # relay1 goes dark: rereg pings start failing, the worker's
+            # consecutive-failure counter crosses the threshold, and the
+            # next rereg sweep rotates to relay2
+            await relay1.stop()
+            for _ in range(400):
+                await asyncio.sleep(0.025)
+                if natd._my_relay == relay2.address:
+                    break
+            assert natd._my_relay == relay2.address, "never failed over"
+            for _ in range(200):
+                await asyncio.sleep(0.025)
+                if (
+                    natd.public_key in relay2.relay_clients
+                    and peer._workers[natd.public_key].peer.host
+                    == wire.relay_host(relay2.public_key)
+                ):
+                    break
+            assert natd.public_key in relay2.relay_clients, (
+                "no registration at the fallback relay"
+            )
+            # the rebind reached the peer: route now points at relay2
+            assert (
+                peer._workers[natd.public_key].peer.host
+                == wire.relay_host(relay2.public_key)
+            ), "peer never learned the new relay route"
+        finally:
+            for m in (relay2, natd, peer):
+                await m.stop()
+
+    asyncio.run(run())
+
+
+def test_single_relay_never_rotates():
+    """With one configured relay there is nowhere to fail over to: the
+    node keeps re-registering against it (outage handled by backoff +
+    eventual relay return), never flapping its advertised address."""
+
+    async def run():
+        relay1 = _mgr(6)
+        natd = _mgr(7)
+        await relay1.start()
+        await natd.start()
+        try:
+            natd.use_relay(relay1.address, reregister_every=0.05)
+            await relay1.stop()
+            await asyncio.sleep(0.6)
+            assert natd._my_relay == relay1.address
+        finally:
+            await natd.stop()
+
+    asyncio.run(run())
