@@ -1,0 +1,39 @@
+# Test slices for CI sharding and local iteration. Each slice targets
+# roughly 10 minutes on a single core; the full suite (`make test`) is
+# the union and takes ~45 minutes. Markers are registered in
+# pyproject.toml — a typo'd marker is a collection error, not a silently
+# empty slice.
+
+PYTEST ?= python -m pytest
+PYTEST_ARGS ?= -q
+
+.PHONY: test test-kernel test-fast test-chaos native bench
+
+# crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
+# TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
+test-kernel:
+	$(PYTEST) $(PYTEST_ARGS) -m kernel
+
+# everything that is neither a kernel test nor a fault-injection run:
+# consensus, storage, network, RPC, node lifecycle — the quick sanity
+# slice to run after most changes
+test-fast:
+	$(PYTEST) $(PYTEST_ARGS) -m "not kernel and not chaos and not crash and not slow"
+
+# fault injection + durability: seeded loss/partition chaos matrices,
+# crash-point injection, SIGKILL-restart recovery
+test-chaos:
+	$(PYTEST) $(PYTEST_ARGS) -m "chaos or crash or slow"
+
+test:
+	$(PYTEST) $(PYTEST_ARGS)
+
+# the native consensus/crypto shared library (no-op when up to date;
+# python loaders also rebuild on demand via source-mtime checks)
+native:
+	$(MAKE) -C lachain_tpu/crypto/native
+	$(MAKE) -C lachain_tpu/consensus/native
+
+bench:
+	python bench.py
+	python benchmarks/bench_consensus_sim.py --n 64 --eras 2

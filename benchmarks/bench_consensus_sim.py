@@ -33,7 +33,12 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=64)
     ap.add_argument("--txs", type=int, default=1000)
     ap.add_argument("--eras", type=int, default=2)
-    ap.add_argument("--max-messages", type=int, default=20_000_000)
+    ap.add_argument(
+        "--max-messages",
+        type=int,
+        default=None,
+        help="livelock guard; default scales with the O(N^2) flood volume",
+    )
     ap.add_argument(
         "--engine",
         default="native",
@@ -41,10 +46,16 @@ def main() -> None:
         help="consensus runtime: native C++ engine or the Python simulator",
     )
     args = ap.parse_args()
+    if args.max_messages is None:
+        # an era floods O(N^2) per RBC/BA round; 20M covers N<=64 with
+        # headroom, larger committees scale quadratically (N=128 eras
+        # legitimately run ~30M+ deliveries)
+        args.max_messages = max(20_000_000, 4_000 * args.n * args.n)
 
     from lachain_tpu.core.devnet import Devnet
     from lachain_tpu.core.types import Transaction, sign_transaction
     from lachain_tpu.crypto import ecdsa
+    from lachain_tpu.utils import metrics
 
     n = args.n
     f = (n - 1) // 3
@@ -65,8 +76,13 @@ def main() -> None:
         engine=args.engine,
     )
 
+    def _exec_total_s() -> float:
+        snap = metrics.timer_snapshot().get("block_execute", {})
+        return snap.get("total_ms", 0.0) / 1e3
+
     total_txs = 0
     times = []
+    exec_times = []  # per-era total block-execution seconds across ALL nodes
     nonces = [0] * len(users)
     for era in range(1, args.eras + 1):
         for k in range(args.txs):
@@ -84,12 +100,22 @@ def main() -> None:
             )
             net.submit_tx(stx)
             nonces[u] += 1
+        e0 = _exec_total_s()
         t0 = time.perf_counter()
         blocks = net.run_era(era, max_messages=args.max_messages)
         times.append(time.perf_counter() - t0)
+        exec_times.append(_exec_total_s() - e0)
         total_txs += len(blocks[0].tx_hashes)
 
-    era_s = min(times)
+    # per-node normalization (VERDICT #8): the in-process sim makes ALL N
+    # validators emulate+execute every block, but a real node executes it
+    # once — (n-1)/n of the measured block_execute time is sim-only
+    # redundancy. The normalized number subtracts that share from the era
+    # wall time; the raw number stays reported next to it.
+    best = min(range(len(times)), key=lambda i: times[i])
+    era_s = times[best]
+    redundant_s = exec_times[best] * (n - 1) / n
+    normalized_s = max(0.0, era_s - redundant_s)
     print(
         json.dumps(
             {
@@ -101,6 +127,17 @@ def main() -> None:
                 "engine": args.engine,
                 "txs_per_era": total_txs // args.eras,
                 "tx_per_s": round(total_txs / sum(times), 1),
+                "per_node_normalized_latency_s": round(normalized_s, 3),
+                "emulate_execute_total_s": round(exec_times[best], 3),
+                "emulate_execute_redundant_share_pct": round(
+                    100.0 * redundant_s / era_s, 1
+                )
+                if era_s
+                else 0.0,
+                "normalization": "normalized = era_wall - block_execute_total"
+                " * (N-1)/N; block_execute timed via utils.metrics"
+                " 'block_execute' (every node executes every block in-sim,"
+                " a real node executes once)",
             }
         )
     )

@@ -36,6 +36,22 @@ def _pow2_at_least(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def _job_fingerprint(job) -> Optional[tuple]:
+    """Content key of an EraSlotJob: verify+combine is a pure function of
+    (shares, lagrange row, H(U,V), W), so two jobs with equal fingerprints
+    (against the same key set) have equal results. Returns None for job
+    shapes the batcher doesn't recognize — those never dedupe."""
+    try:
+        return (
+            tuple(job.u_by_validator),
+            tuple(job.lagrange_row),
+            job.h,
+            job.w,
+        )
+    except (AttributeError, TypeError):
+        return None
+
+
 class TpkeEraBatcher:
     """Collects (jobs, callback) submissions; flush() runs them in one call."""
 
@@ -86,14 +102,35 @@ class TpkeEraBatcher:
         # validator set), but shares MUST verify against their own keys —
         # group by key-set identity so a future caller with per-era DKG keys
         # can never have shares checked against another era's keys
+        # cross-validator dedupe: in-process, every validator's HoneyBadger
+        # submits the SAME (shares, coeffs, ciphertext) job for each slot —
+        # N identical pure-function evaluations. Execute each distinct
+        # (key-set, fingerprint) job once and fan the result back out.
         flat_jobs: List = []
         owners: List[Tuple[int, int]] = []  # (submission idx, job idx)
         key_of: List = []  # per-flat-job key-set object
+        alias: List[int] = []  # per-original-job index into flat_jobs
+        seen: dict = {}  # (id(vks), fingerprint) -> flat index
+        n_jobs = 0
         for si, (jobs, vks, _cb) in enumerate(batch):
             for ji, job in enumerate(jobs):
-                flat_jobs.append(job)
+                n_jobs += 1
                 owners.append((si, ji))
-                key_of.append(vks)
+                fp = _job_fingerprint(job)
+                idx = (
+                    seen.get((id(vks), fp)) if fp is not None else None
+                )
+                if idx is None:
+                    idx = len(flat_jobs)
+                    flat_jobs.append(job)
+                    key_of.append(vks)
+                    if fp is not None:
+                        seen[(id(vks), fp)] = idx
+                alias.append(idx)
+        if n_jobs > len(flat_jobs):
+            metrics.inc(
+                "tpke_flush_deduped_slots_total", n_jobs - len(flat_jobs)
+            )
         results: List = [None] * len(flat_jobs)
         sid = tracing.begin(
             "tpke.flush", cat="crypto", submissions=len(batch)
@@ -135,6 +172,7 @@ class TpkeEraBatcher:
         tracing.end(
             sid,
             slots=len(flat_jobs),
+            slots_submitted=n_jobs,
             slots_padded=padded,
             pad_waste=round(waste, 4),
         )
@@ -150,8 +188,8 @@ class TpkeEraBatcher:
         per_sub: List[List] = [
             [None] * len(jobs) for (jobs, _vks, _cb) in batch
         ]
-        for (si, ji), res in zip(owners, results):
-            per_sub[si][ji] = res
+        for (si, ji), ai in zip(owners, alias):
+            per_sub[si][ji] = results[ai]
         for (jobs, _vks, cb), res in zip(batch, per_sub):
             cb(res)
         return len(batch)

@@ -25,6 +25,8 @@
 #include <cstring>
 #include <cstdlib>
 #include <deque>
+#include <map>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
@@ -367,6 +369,7 @@ struct Entry {
 struct Bits {
   uint64_t w[4] = {0, 0, 0, 0};
   inline void set(int i) { w[i >> 6] |= 1ULL << (i & 63); }
+  inline void clr(int i) { w[i >> 6] &= ~(1ULL << (i & 63)); }
   inline bool test(int i) const { return (w[i >> 6] >> (i & 63)) & 1; }
   inline int count() const {
     return __builtin_popcountll(w[0]) + __builtin_popcountll(w[1]) +
@@ -384,6 +387,72 @@ typedef void (*acs_cb_t)(int32_t target, int32_t era, int32_t nslots,
                          const size_t* lens);
 typedef void (*coinreq_cb_t)(int32_t target, int32_t era, int32_t agreement,
                              int32_t epoch);
+// Generic batched crossing for the natively-hosted crypto protocols
+// (HoneyBadger / CommonCoin / RootProtocol). One crossing carries one crypto
+// work item — often covering MANY messages (all pending coin shares, all
+// ready decrypt-share slots, all unverified header signatures) — replacing
+// the per-message cb_opaque round-trip on the era hot path.
+typedef void (*cross_cb_t)(int32_t target, int32_t era, int32_t op, int32_t a,
+                           int32_t b, const uint8_t* data, size_t len);
+
+// Per-validator native-ownership mask (set from Python at request time; a
+// validator with a Python override factory keeps the bit clear and its
+// messages keep flowing through cb_opaque).
+enum OwnMask { OWN_HB = 1, OWN_COIN = 2, OWN_ROOT = 4 };
+
+// Opaque payload kinds — must match native_rt.py KIND_*.
+enum OpqKind { K_DECRYPTED = 0, K_SIGNED_HEADER = 1, K_COIN = 2 };
+
+// Engine -> Python crossing ops (cross_cb_t `op`).
+enum CrossOp {
+  XO_COIN_SIGN = 1,      // a=agreement b=epoch: sign + post own share
+  XO_COIN_COMBINE = 2,   // blob [(u32 sender,u32 len,share)...]: add + combine
+  XO_COIN_RESULT = 3,    // a=agreement b=epoch data[0]=parity: Python parent
+  XO_HB_ACS = 4,         // blob [(u32 slot,u32 len,ciphertext)...]
+  XO_HB_QUEUE = 5,       // queue one lazy batcher build for the ready slots
+  XO_HB_DONE = 6,        // a=1 when a Python parent awaits the result
+  XO_ROOT_INPUT = 7,     // propose txs, encrypt, post PO_HB_ACS_INPUT
+  XO_ROOT_SIGN = 8,      // a=nonce parity: build + sign header
+  XO_ROOT_VERIFY = 9,    // blob [(u32 sender,u32 len,sig)...]: ECDSA verify
+  XO_ROOT_PRODUCE = 10,  // assemble multisig + produce the block
+};
+
+// Python -> engine post ops (rt_post `op`).
+enum PostOp {
+  PO_COIN_SHARE = 1,        // a=agreement b=epoch data=own share bytes
+  PO_COIN_RESULT = 2,       // a=agreement b=epoch data[0]=parity
+  PO_HB_ACS_INPUT = 3,      // data = encrypted proposal (starts native ACS)
+  PO_HB_DECRYPTED = 4,      // a=slot data=own decrypt-share payload
+  PO_HB_ACS_DONE = 5,       // ciphertexts registered: replay stash
+  PO_HB_RESOLVED = 6,       // a=slot: plaintext (or garbage) settled
+  PO_HB_REJECT = 7,         // a=slot b=sender: share failed verification
+  PO_HB_SET_INFLIGHT = 8,   // a=slot: owned by an in-flight batcher build
+  PO_HB_CLEAR_INFLIGHT = 9, // a=slot
+  PO_HB_CLEAR_QUEUED = 10,
+  PO_HB_REQUEUE_CHECK = 11,
+  PO_ROOT_HEADER = 12,  // blob = be32 own_len | own bytes | broadcast bytes
+  PO_ROOT_ACCEPT = 13,  // a=sender: header signature verified
+  PO_ROOT_REJECT = 14,  // a=sender: invalid signature (sender may retry)
+};
+
+// rt_request kinds (Python-side divert of era.py::internal_request).
+enum ReqKind { RQ_HB = 1, RQ_COIN = 2, RQ_ROOT = 3 };
+
+// Parent routing for native protocol results.
+enum ParentKind { PK_NONE = 0, PK_BA = 1, PK_ROOT = 2, PK_PY = 3 };
+
+static const size_t G1_BYTES = 96, G2_BYTES = 192;
+
+static inline void put_be32(std::string& s, uint32_t v) {
+  s.push_back((char)(v >> 24));
+  s.push_back((char)(v >> 16));
+  s.push_back((char)(v >> 8));
+  s.push_back((char)v);
+}
+static inline uint32_t get_be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
 
 struct Engine;
 
@@ -494,25 +563,90 @@ struct ACS {
   void try_complete();
 };
 
+// --- Native hosts for the crypto-bearing protocols -------------------------
+// CommonCoin / HoneyBadger / RootProtocol run their MESSAGE state machines
+// here, mirroring common_coin.py / honey_badger.py / root_protocol.py
+// statement-for-statement; every cryptographic operation (BLS combine, TPKE
+// verify/combine, ECDSA sign/verify) crosses to Python in BATCHES via
+// cross_cb_t, where host shims (native_hosts.py) drive the same crypto code
+// the pinned oracle classes use.
+
+struct NCoin {  // common_coin.py::CommonCoin message layer
+  Engine* E;
+  int vid, agreement, epoch;
+  int parent = PK_NONE;
+  bool requested = false, done = false;
+  int result = -1;
+  std::map<int, std::string> raw;    // sender -> share bytes (sorted)
+  std::unordered_set<int> shipped;   // senders already crossed to the signer
+  void on_request(int parent_kind);
+  void on_share(int sender, const std::string& data);
+  void on_own_share(const std::string& data);
+  void on_result(int parity);
+  void try_combine();
+  void route_result();
+};
+
+struct NHB {  // honey_badger.py::HoneyBadger message layer
+  Engine* E;
+  int vid;
+  int parent = PK_NONE;
+  bool have_cts = false, done = false, queued = false;
+  int total_slots = 0;
+  std::set<int> ct_slots;            // valid ciphertext slots (sorted)
+  std::unordered_set<int> resolved;  // slots with settled plaintexts
+  std::unordered_set<int> inflight;  // slots owned by an in-flight build
+  std::unordered_map<int, std::map<int, std::string>> shares;
+  std::unordered_map<int, std::unordered_set<int>> rejected;
+  std::vector<std::pair<std::pair<int, int>, std::string>> stash;  // pre-ACS
+  std::set<std::pair<int, int>> stash_keys;
+  void on_decrypted(int sender, int slot, const std::string& data);
+  void apply_share(int sender, int slot, const std::string& data, bool defer);
+  void on_acs(const std::vector<int32_t>& slots,
+              std::unordered_map<int, std::string>& results);
+  void on_acs_done();
+  bool slot_ready(int slot) const;
+  bool any_ready() const;
+  void queue_check();
+  void check_done();
+  void export_ready(std::string& out) const;
+};
+
+struct NRoot {  // root_protocol.py::RootProtocol message layer
+  Engine* E;
+  int vid;
+  bool requested = false, hb_done = false, header_posted = false,
+       produced = false;
+  int nonce_parity = -1;
+  std::string own_data;  // be32 header-len | header bytes | own signature
+  Bits verified, pending_bits;
+  int verified_count = 0;
+  std::vector<std::pair<int, std::string>> pending;  // (sender, unverified sig)
+  std::vector<std::pair<int, std::string>> early;    // pre-header stash
+  void on_request();
+  void on_header(int sender, const std::string& data);
+  void on_hb_done();
+  void on_nonce(int parity);
+  void on_own_header(const std::string& blob);
+  void try_sign();
+  void maybe_verify();
+};
+
 struct Validator {
   int era = 0;
   std::unordered_map<uint64_t, BB*> bb;   // key (agreement+1)<<32 | epoch
   std::unordered_map<int, BA*> ba;
   std::unordered_map<int, RBC*> rbc;
   ACS* acs = nullptr;
+  uint8_t own_mask = 0;    // OwnMask bits: which crypto protocols run native
+  bool acs_to_hb = false;  // route the ACS result to the native HB host
+  std::unordered_map<uint64_t, NCoin*> ncoin;  // key (agreement+1)<<32 | epoch
+  NHB* nhb = nullptr;
+  NRoot* nroot = nullptr;
   std::vector<Entry> postponed;
   std::unordered_map<int, int> postponed_per_sender;
 
-  void clear_protocols() {
-    for (auto& kv : bb) delete kv.second;
-    bb.clear();
-    for (auto& kv : ba) delete kv.second;
-    ba.clear();
-    for (auto& kv : rbc) delete kv.second;
-    rbc.clear();
-    delete acs;
-    acs = nullptr;
-  }
+  void clear_protocols();  // defined after Engine (touches hb_queued_count)
 };
 
 struct Engine {
@@ -527,9 +661,13 @@ struct Engine {
   uint64_t opq_pending[8] = {0};  // queued opaque entries per kind (flush cue)
   bool stop_req = false;  // pulsed by Python on top-level protocol completion
   int postponed_sender_cap = 256;  // era.py::_postponed_sender_cap
+  int coin_need = 0;               // ts_keys.t + 1 (set from Python)
+  uint64_t native_handled = 0;     // opaque deliveries handled without Python
+  int hb_queued_count = 0;         // native HBs with a queued batcher build
   opaque_cb_t cb_opaque = nullptr;
   acs_cb_t cb_acs = nullptr;
   coinreq_cb_t cb_coinreq = nullptr;
+  cross_cb_t cb_cross = nullptr;
 
   Engine(int n_, int f_, int mode_, uint32_t ppm, uint64_t seed, int era0)
       : n(n_), f(f_), mode(mode_), repeat_ppm(ppm) {
@@ -702,6 +840,10 @@ struct Engine {
         break;
       }
       case MT_OPAQUE:
+        if (deliver_native_opaque(V, e)) {
+          native_handled++;
+          break;
+        }
         if (cb_opaque)
           cb_opaque(e.target, e.sender, m->era, m->opq_kind, m->agreement,
                     m->epoch, reinterpret_cast<const uint8_t*>(m->data.data()),
@@ -757,22 +899,7 @@ struct Engine {
     ACS* a = vals[vid].acs;
     if (a) a->on_rbc_result(slot, v);
   }
-  void deliver_acs_result(int vid, ACS* a) {
-    std::vector<int32_t> slots;
-    for (auto& kv : a->ba_results)
-      if (kv.second) slots.push_back(kv.first);
-    std::sort(slots.begin(), slots.end());
-    std::vector<const uint8_t*> ptrs;
-    std::vector<size_t> lens;
-    for (int32_t s : slots) {
-      const std::string& d = a->rbc_results[s];
-      ptrs.push_back(reinterpret_cast<const uint8_t*>(d.data()));
-      lens.push_back(d.size());
-    }
-    if (cb_acs)
-      cb_acs(vid, vals[vid].era, (int32_t)slots.size(), slots.data(),
-             ptrs.data(), lens.data());
-  }
+  void deliver_acs_result(int vid, ACS* a);  // routes to NHB or cb_acs
 
   // requests from native parents (synchronous, like era.py::internal_request)
   void request_bb(int vid, int agreement, int epoch, int est) {
@@ -788,9 +915,18 @@ struct Engine {
     RBC* r = get_rbc(vals[vid], slot, true);
     if (r) r->on_request(has_value, value);
   }
-  void request_coin(int vid, int agreement, int epoch) {
-    if (cb_coinreq) cb_coinreq(vid, vals[vid].era, agreement, epoch);
-  }
+  void request_coin(int vid, int agreement, int epoch);  // NCoin or cb_coinreq
+
+  // -- native crypto-protocol hosting (implementations after the protocol
+  //    bodies; they touch NCoin/NHB/NRoot) --------------------------------
+  void cross(int vid, int op, int a, int b, const std::string& blob);
+  NCoin* get_ncoin(Validator& V, int agreement, int epoch, bool create);
+  NHB* get_nhb(Validator& V, bool create);
+  NRoot* get_nroot(Validator& V, bool create);
+  bool deliver_native_opaque(Validator& V, const Entry& e);
+  void native_request(int vid, int kind, int a, int b);
+  void native_post(int vid, int op, int a, int b, const uint8_t* data,
+                   size_t len);
 };
 
 }  // namespace
@@ -1160,6 +1296,564 @@ void ACS::try_complete() {
   E->deliver_acs_result(vid, this);
 }
 
+// ---------------------------------------------------------------------------
+// Native crypto-protocol hosting (engine plumbing + NCoin/NHB/NRoot)
+// ---------------------------------------------------------------------------
+
+void Validator::clear_protocols() {
+  for (auto& kv : bb) delete kv.second;
+  bb.clear();
+  for (auto& kv : ba) delete kv.second;
+  ba.clear();
+  for (auto& kv : rbc) delete kv.second;
+  rbc.clear();
+  delete acs;
+  acs = nullptr;
+  for (auto& kv : ncoin) delete kv.second;
+  ncoin.clear();
+  if (nhb && nhb->queued) nhb->E->hb_queued_count--;
+  delete nhb;
+  nhb = nullptr;
+  delete nroot;
+  nroot = nullptr;
+  acs_to_hb = false;
+}
+
+void Engine::cross(int vid, int op, int a, int b, const std::string& blob) {
+  if (cb_cross)
+    cb_cross(vid, vals[vid].era, op, a, b,
+             reinterpret_cast<const uint8_t*>(blob.data()), blob.size());
+}
+
+NCoin* Engine::get_ncoin(Validator& V, int agreement, int epoch, bool create) {
+  // era.py::_validate_id for CoinId (NONCE_AGREEMENT = -1 allowed)
+  if (!((agreement >= 0 && agreement < n) || agreement == -1) || epoch < 0)
+    return nullptr;
+  uint64_t key = ((uint64_t)(uint32_t)(agreement + 1) << 32) | (uint32_t)epoch;
+  auto it = V.ncoin.find(key);
+  if (it != V.ncoin.end()) return it->second;
+  if (!create) return nullptr;
+  NCoin* c = new NCoin();
+  c->E = this;
+  c->vid = (int)(&V - vals.data());
+  c->agreement = agreement;
+  c->epoch = epoch;
+  V.ncoin[key] = c;
+  return c;
+}
+
+NHB* Engine::get_nhb(Validator& V, bool create) {
+  if (!V.nhb && create) {
+    V.nhb = new NHB();
+    V.nhb->E = this;
+    V.nhb->vid = (int)(&V - vals.data());
+  }
+  return V.nhb;
+}
+
+NRoot* Engine::get_nroot(Validator& V, bool create) {
+  if (!V.nroot && create) {
+    V.nroot = new NRoot();
+    V.nroot->E = this;
+    V.nroot->vid = (int)(&V - vals.data());
+  }
+  return V.nroot;
+}
+
+void Engine::request_coin(int vid, int agreement, int epoch) {
+  Validator& V = vals[vid];
+  if (V.own_mask & OWN_COIN) {
+    NCoin* c = get_ncoin(V, agreement, epoch, true);
+    if (c) c->on_request(PK_BA);
+    return;
+  }
+  if (cb_coinreq) cb_coinreq(vid, V.era, agreement, epoch);
+}
+
+void Engine::deliver_acs_result(int vid, ACS* a) {
+  std::vector<int32_t> slots;
+  for (auto& kv : a->ba_results)
+    if (kv.second) slots.push_back(kv.first);
+  std::sort(slots.begin(), slots.end());
+  Validator& V = vals[vid];
+  if (V.acs_to_hb && (V.own_mask & OWN_HB)) {
+    NHB* hb = get_nhb(V, true);
+    hb->on_acs(slots, a->rbc_results);
+    return;
+  }
+  std::vector<const uint8_t*> ptrs;
+  std::vector<size_t> lens;
+  for (int32_t s : slots) {
+    const std::string& d = a->rbc_results[s];
+    ptrs.push_back(reinterpret_cast<const uint8_t*>(d.data()));
+    lens.push_back(d.size());
+  }
+  if (cb_acs)
+    cb_acs(vid, V.era, (int32_t)slots.size(), slots.data(), ptrs.data(),
+           lens.data());
+}
+
+bool Engine::deliver_native_opaque(Validator& V, const Entry& e) {
+  Msg* m = e.m;
+  switch (m->opq_kind) {
+    case K_DECRYPTED: {
+      if (!(V.own_mask & OWN_HB)) return false;
+      NHB* hb = get_nhb(V, true);
+      hb->on_decrypted(e.sender, m->agreement, m->data);
+      // Flush cue, mirroring the Python simulator's per-pop check
+      // (`crypto_batcher.pending and _decrypted_in_queue == 0`): the moment
+      // the last queued decrypt-share is delivered while some native HB has
+      // a batcher build queued, pulse stop so the driver flushes.
+      if (hb_queued_count > 0 && opq_pending[K_DECRYPTED] == 0)
+        stop_req = true;
+      return true;
+    }
+    case K_COIN: {
+      if (!(V.own_mask & OWN_COIN)) return false;
+      NCoin* c = get_ncoin(V, m->agreement, m->epoch, true);
+      if (c) c->on_share(e.sender, m->data);
+      return true;
+    }
+    case K_SIGNED_HEADER: {
+      if (!(V.own_mask & OWN_ROOT)) return false;
+      NRoot* r = get_nroot(V, true);
+      r->on_header(e.sender, m->data);
+      return true;
+    }
+  }
+  return false;
+}
+
+void Engine::native_request(int vid, int kind, int a, int b) {
+  Validator& V = vals[vid];
+  switch (kind) {
+    case RQ_COIN: {
+      NCoin* c = get_ncoin(V, a, b, true);
+      if (c) c->on_request(PK_PY);
+      break;
+    }
+    case RQ_HB: {
+      NHB* hb = get_nhb(V, true);
+      hb->parent = PK_PY;
+      if (hb->done)  // protocol.py::receive Request-replay path
+        cross(vid, XO_HB_DONE, 1, 0, std::string());
+      break;
+    }
+    case RQ_ROOT: {
+      NRoot* r = get_nroot(V, true);
+      r->on_request();
+      break;
+    }
+  }
+}
+
+void Engine::native_post(int vid, int op, int a, int b, const uint8_t* data,
+                         size_t len) {
+  Validator& V = vals[vid];
+  std::string blob(reinterpret_cast<const char*>(data), len);
+  switch (op) {
+    case PO_COIN_SHARE: {
+      NCoin* c = get_ncoin(V, a, b, true);
+      if (c) c->on_own_share(blob);
+      break;
+    }
+    case PO_COIN_RESULT: {
+      NCoin* c = get_ncoin(V, a, b, false);
+      if (c) c->on_result(len ? (int)(uint8_t)blob[0] : 0);
+      break;
+    }
+    case PO_HB_ACS_INPUT: {
+      V.acs_to_hb = true;
+      if (!V.acs) {
+        V.acs = new ACS();
+        V.acs->E = this;
+        V.acs->vid = vid;
+      }
+      V.acs->on_request(blob);
+      break;
+    }
+    case PO_HB_DECRYPTED: {
+      // own decrypt share: register the ciphertext slot, broadcast FIRST,
+      // then record (honey_badger.py::handle_child_result statement order)
+      NHB* hb = get_nhb(V, true);
+      hb->ct_slots.insert(a);
+      Msg* m = new Msg();
+      m->type = MT_OPAQUE;
+      m->era = V.era;
+      m->opq_kind = K_DECRYPTED;
+      m->agreement = a;
+      m->epoch = 0;
+      m->data = blob;
+      bcast(vid, m);
+      hb->shares[a][vid] = blob;
+      break;
+    }
+    case PO_HB_ACS_DONE: {
+      NHB* hb = get_nhb(V, true);
+      hb->on_acs_done();
+      break;
+    }
+    case PO_HB_RESOLVED: {
+      NHB* hb = get_nhb(V, true);
+      hb->resolved.insert(a);
+      hb->check_done();
+      break;
+    }
+    case PO_HB_REJECT: {
+      NHB* hb = get_nhb(V, false);
+      if (!hb) break;
+      auto it = hb->shares.find(a);
+      if (it != hb->shares.end()) it->second.erase(b);
+      hb->rejected[a].insert(b);
+      break;
+    }
+    case PO_HB_SET_INFLIGHT: {
+      NHB* hb = get_nhb(V, false);
+      if (hb) hb->inflight.insert(a);
+      break;
+    }
+    case PO_HB_CLEAR_INFLIGHT: {
+      NHB* hb = get_nhb(V, false);
+      if (hb) hb->inflight.erase(a);
+      break;
+    }
+    case PO_HB_CLEAR_QUEUED: {
+      NHB* hb = get_nhb(V, false);
+      if (hb && hb->queued) {
+        hb->queued = false;
+        hb_queued_count--;
+      }
+      break;
+    }
+    case PO_HB_REQUEUE_CHECK: {
+      NHB* hb = get_nhb(V, false);
+      if (hb) hb->queue_check();
+      break;
+    }
+    case PO_ROOT_HEADER: {
+      NRoot* r = get_nroot(V, true);
+      r->on_own_header(blob);
+      break;
+    }
+    case PO_ROOT_ACCEPT: {
+      NRoot* r = get_nroot(V, false);
+      if (!r) break;
+      if (!r->verified.test(a)) {
+        r->verified.set(a);
+        r->verified_count++;
+      }
+      r->pending_bits.clr(a);
+      break;
+    }
+    case PO_ROOT_REJECT: {
+      NRoot* r = get_nroot(V, false);
+      if (r) r->pending_bits.clr(a);  // sender may retry (oracle re-verifies)
+      break;
+    }
+  }
+}
+
+// --- NCoin (common_coin.py) ------------------------------------------------
+
+void NCoin::on_request(int parent_kind) {
+  parent = parent_kind;
+  if (done) {  // protocol.py::receive Request-replay path
+    route_result();
+    return;
+  }
+  if (requested) return;
+  requested = true;
+  E->cross(vid, XO_COIN_SIGN, agreement, epoch, std::string());
+  // Python signed and posted the own share synchronously (PO_COIN_SHARE).
+}
+
+void NCoin::on_own_share(const std::string& data) {
+  // common_coin.py::handle_input: broadcast FIRST, then record + combine
+  Msg* m = new Msg();
+  m->type = MT_OPAQUE;
+  m->era = E->vals[vid].era;
+  m->opq_kind = K_COIN;
+  m->agreement = agreement;
+  m->epoch = epoch;
+  m->data = data;
+  E->bcast(vid, m);
+  raw[vid] = data;
+  shipped.insert(vid);  // the Python signer already holds its own share
+  try_combine();
+}
+
+void NCoin::on_share(int sender, const std::string& data) {
+  // common_coin.py::handle_external
+  if (done || raw.count(sender)) return;
+  if (data.size() != G2_BYTES + 4) return;
+  if (get_be32(reinterpret_cast<const uint8_t*>(data.data()) + G2_BYTES) !=
+      (uint32_t)sender)
+    return;
+  raw[sender] = data;
+  try_combine();
+}
+
+void NCoin::try_combine() {
+  // common_coin.py::_try_combine: the need check counts ALL stored shares;
+  // only not-yet-shipped ones cross (the Python signer keeps the rest), and
+  // the crossing happens even with an empty delta — the oracle re-evaluates
+  // the combined signature on every call past the threshold.
+  if (done || (int)raw.size() < E->coin_need) return;
+  std::string blob;
+  for (auto& kv : raw) {
+    if (shipped.count(kv.first)) continue;
+    put_be32(blob, (uint32_t)kv.first);
+    put_be32(blob, (uint32_t)kv.second.size());
+    blob += kv.second;
+  }
+  for (auto& kv : raw) shipped.insert(kv.first);
+  E->cross(vid, XO_COIN_COMBINE, agreement, epoch, blob);
+  // Python posted PO_COIN_RESULT re-entrantly if the signature completed.
+}
+
+void NCoin::on_result(int parity) {
+  if (done) return;
+  done = true;
+  result = parity ? 1 : 0;
+  route_result();
+}
+
+void NCoin::route_result() {
+  if (result < 0) return;
+  if (parent == PK_BA) {
+    auto it = E->vals[vid].ba.find(agreement);
+    if (it != E->vals[vid].ba.end())
+      it->second->on_coin_result(epoch, result != 0);
+  } else if (parent == PK_ROOT) {
+    NRoot* r = E->vals[vid].nroot;
+    if (r) r->on_nonce(result);
+  } else if (parent == PK_PY) {
+    std::string blob(1, (char)result);
+    E->cross(vid, XO_COIN_RESULT, agreement, epoch, blob);
+  }
+}
+
+// --- NHB (honey_badger.py) -------------------------------------------------
+
+void NHB::on_acs(const std::vector<int32_t>& slots,
+                 std::unordered_map<int, std::string>& results) {
+  if (have_cts || done) return;
+  total_slots = (int)slots.size();
+  std::string blob;
+  for (int32_t s : slots) {
+    const std::string& d = results[s];
+    put_be32(blob, (uint32_t)s);
+    put_be32(blob, (uint32_t)d.size());
+    blob += d;
+  }
+  E->cross(vid, XO_HB_ACS, total_slots, 0, blob);
+  // Python decoded + batch-verified the ciphertexts, posted PO_HB_RESOLVED
+  // for garbage slots and PO_HB_DECRYPTED per valid slot (in sorted slot
+  // order, preserving the oracle's broadcast order), then PO_HB_ACS_DONE.
+}
+
+void NHB::on_acs_done() {
+  have_cts = true;
+  auto st = std::move(stash);
+  stash.clear();
+  stash_keys.clear();
+  // honey_badger.py::handle_child_result: replay the early stash with
+  // deferred batching, then one ready check and one completion check
+  for (auto& e : st) apply_share(e.first.first, e.first.second, e.second, true);
+  queue_check();
+  check_done();
+}
+
+void NHB::on_decrypted(int sender, int slot, const std::string& data) {
+  if (!have_cts) {
+    // honey_badger.py::handle_external pre-ACS stash (bounded slot, deduped)
+    if (slot < 0 || slot >= E->n) return;
+    auto key = std::make_pair(sender, slot);
+    if (stash_keys.count(key)) return;
+    stash_keys.insert(key);
+    stash.emplace_back(key, data);
+    return;
+  }
+  apply_share(sender, slot, data, false);
+}
+
+void NHB::apply_share(int sender, int slot, const std::string& data,
+                      bool defer) {
+  // honey_badger.py::_on_decrypted
+  if (!ct_slots.count(slot)) return;  // unknown or invalid ciphertext slot
+  if (resolved.count(slot)) return;   // plaintext already settled
+  if (data.size() != G1_BYTES + 8) return;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data());
+  if (get_be32(p + G1_BYTES) != (uint32_t)sender) return;
+  if (get_be32(p + G1_BYTES + 4) != (uint32_t)slot) return;
+  auto rj = rejected.find(slot);
+  if (rj != rejected.end() && rj->second.count(sender)) return;
+  auto& m = shares[slot];
+  if (m.count(sender)) return;
+  m[sender] = data;
+  if (defer) return;
+  if (!queued && !inflight.count(slot) && (int)m.size() >= E->f + 1) {
+    queued = true;
+    E->hb_queued_count++;
+    E->cross(vid, XO_HB_QUEUE, 0, 0, std::string());
+  }
+}
+
+bool NHB::slot_ready(int slot) const {
+  if (resolved.count(slot) || inflight.count(slot)) return false;
+  auto it = shares.find(slot);
+  return it != shares.end() && (int)it->second.size() >= E->f + 1;
+}
+
+bool NHB::any_ready() const {
+  for (int s : ct_slots)
+    if (slot_ready(s)) return true;
+  return false;
+}
+
+void NHB::queue_check() {
+  if (done || queued || !any_ready()) return;
+  queued = true;
+  E->hb_queued_count++;
+  E->cross(vid, XO_HB_QUEUE, 0, 0, std::string());
+}
+
+void NHB::check_done() {
+  if (done || !have_cts) return;
+  if ((int)resolved.size() < total_slots) return;
+  done = true;
+  E->cross(vid, XO_HB_DONE, parent == PK_PY ? 1 : 0, 0, std::string());
+  if (parent == PK_ROOT) {
+    NRoot* r = E->vals[vid].nroot;
+    if (r) r->on_hb_done();
+  }
+}
+
+void NHB::export_ready(std::string& out) const {
+  // [(u32 slot, u32 nsenders, (u32 sender, u32 len, share)*)*], slots and
+  // senders ascending — matches the oracle's sorted candidate iteration
+  for (int s : ct_slots) {
+    if (!slot_ready(s)) continue;
+    const auto& m = shares.at(s);
+    put_be32(out, (uint32_t)s);
+    put_be32(out, (uint32_t)m.size());
+    for (auto& kv : m) {
+      put_be32(out, (uint32_t)kv.first);
+      put_be32(out, (uint32_t)kv.second.size());
+      out += kv.second;
+    }
+  }
+}
+
+// --- NRoot (root_protocol.py) ----------------------------------------------
+
+void NRoot::on_request() {
+  if (requested) return;
+  requested = true;
+  // root_protocol.py::handle_input order: the HoneyBadger request (RBC VAL
+  // sends) must hit the queue before the nonce-coin share broadcast
+  Validator& V = E->vals[vid];
+  NHB* hb = E->get_nhb(V, true);
+  hb->parent = PK_ROOT;
+  E->cross(vid, XO_ROOT_INPUT, 0, 0, std::string());
+  NCoin* c = E->get_ncoin(V, -1, 0, true);
+  if (c) c->on_request(PK_ROOT);
+}
+
+void NRoot::on_hb_done() {
+  hb_done = true;
+  try_sign();
+}
+
+void NRoot::on_nonce(int parity) {
+  if (nonce_parity < 0) nonce_parity = parity ? 1 : 0;
+  try_sign();
+}
+
+void NRoot::try_sign() {
+  if (header_posted || produced || !hb_done || nonce_parity < 0) return;
+  E->cross(vid, XO_ROOT_SIGN, nonce_parity, 0, std::string());
+  // Python built + signed the header and posted PO_ROOT_HEADER.
+}
+
+void NRoot::on_own_header(const std::string& blob) {
+  // blob = be32 L | own bytes (L) | broadcast bytes. The broadcast segment
+  // may be journal-substituted recorded bytes; header matching always uses
+  // the freshly derived own bytes, exactly like the Python oracle.
+  if (header_posted || blob.size() < 4) return;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(blob.data());
+  uint32_t own_len = get_be32(p);
+  if (blob.size() < 4 + (size_t)own_len) return;
+  own_data = blob.substr(4, own_len);
+  std::string wire = blob.substr(4 + (size_t)own_len);
+  header_posted = true;
+  Msg* m = new Msg();
+  m->type = MT_OPAQUE;
+  m->era = E->vals[vid].era;
+  m->opq_kind = K_SIGNED_HEADER;
+  m->agreement = 0;
+  m->epoch = 0;
+  m->data = wire;
+  E->bcast(vid, m);
+  verified.set(vid);
+  verified_count = 1;
+  // early-header replay in stash order (root_protocol.py dict order)
+  auto st = std::move(early);
+  early.clear();
+  for (auto& e : st) on_header(e.first, e.second);
+  maybe_verify();
+}
+
+void NRoot::on_header(int sender, const std::string& data) {
+  if (produced) return;  // post-production headers have no observable effect
+  if (!header_posted) {
+    // root_protocol.py: one stashed header per sender; a later arrival
+    // replaces the payload but keeps the original stash position
+    for (auto& e : early)
+      if (e.first == sender) {
+        e.second = data;
+        return;
+      }
+    early.emplace_back(sender, data);
+    return;
+  }
+  if (verified.test(sender) || pending_bits.test(sender)) return;
+  if (data.size() < 4 || own_data.size() < 4) return;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data());
+  const uint8_t* q = reinterpret_cast<const uint8_t*>(own_data.data());
+  uint32_t hlen = get_be32(p);
+  if (hlen != get_be32(q)) return;
+  if (data.size() < 4 + (size_t)hlen) return;
+  if (std::memcmp(p + 4, q + 4, hlen) != 0) return;  // header mismatch: drop
+  pending.emplace_back(sender, data.substr(4 + (size_t)hlen));
+  pending_bits.set(sender);
+  maybe_verify();
+}
+
+void NRoot::maybe_verify() {
+  // Deferred batch verification: the crossing triggers exactly when
+  // verified + pending first reaches n-f — the same arrival at which the
+  // per-message oracle's _signatures reaches n-f when all pending pass, and
+  // re-triggers on each later arrival otherwise, so the production point is
+  // positionally identical in both engines.
+  if (produced || !header_posted) return;
+  if (verified_count + (int)pending.size() < E->n - E->f) return;
+  if (!pending.empty()) {
+    std::string blob;
+    for (auto& pr : pending) {
+      put_be32(blob, (uint32_t)pr.first);
+      put_be32(blob, (uint32_t)pr.second.size());
+      blob += pr.second;
+    }
+    pending.clear();  // accept/reject posts update the bits re-entrantly
+    E->cross(vid, XO_ROOT_VERIFY, 0, 0, blob);
+  }
+  if (!produced && verified_count >= E->n - E->f) {
+    produced = true;
+    E->cross(vid, XO_ROOT_PRODUCE, 0, 0, std::string());
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1168,7 +1862,7 @@ void ACS::try_complete() {
 
 extern "C" {
 
-int lt_crt_version() { return 1; }
+int lt_crt_version() { return 2; }
 
 void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
              int era0) {
@@ -1177,11 +1871,87 @@ void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
 
 void rt_free(void* h) { delete static_cast<Engine*>(h); }
 
-void rt_set_callbacks(void* h, opaque_cb_t o, acs_cb_t a, coinreq_cb_t c) {
+void rt_set_callbacks(void* h, opaque_cb_t o, acs_cb_t a, coinreq_cb_t c,
+                      cross_cb_t x) {
   Engine* E = static_cast<Engine*>(h);
   E->cb_opaque = o;
   E->cb_acs = a;
   E->cb_coinreq = c;
+  E->cb_cross = x;
+}
+
+// -- native crypto-protocol hosting ----------------------------------------
+
+void rt_set_owned(void* h, int vid, int mask) {
+  static_cast<Engine*>(h)->vals[vid].own_mask = (uint8_t)mask;
+}
+
+void rt_set_coin_need(void* h, int need) {
+  static_cast<Engine*>(h)->coin_need = need;
+}
+
+void rt_request(void* h, int vid, int kind, int a, int b) {
+  static_cast<Engine*>(h)->native_request(vid, kind, a, b);
+}
+
+void rt_post(void* h, int vid, int op, int a, int b, const uint8_t* data,
+             size_t len) {
+  static_cast<Engine*>(h)->native_post(vid, op, a, b, data, len);
+}
+
+// Two-call export of a native HB's ready decrypt-share slots: size query
+// with buf == NULL, then the copying call (single-threaded, so no race).
+size_t rt_hb_ready_export(void* h, int vid, uint8_t* buf, size_t cap) {
+  Engine* E = static_cast<Engine*>(h);
+  NHB* hb = E->vals[vid].nhb;
+  if (!hb) return 0;
+  std::string out;
+  hb->export_ready(out);
+  if (!buf || out.size() > cap) return out.size();
+  std::memcpy(buf, out.data(), out.size());
+  return out.size();
+}
+
+uint64_t rt_native_handled(void* h) {
+  return static_cast<Engine*>(h)->native_handled;
+}
+
+// Watchdog introspection: render one validator's native crypto-protocol
+// state so a stall report can name where a natively-owned id is stuck.
+size_t rt_debug_state(void* h, int vid, char* buf, size_t cap) {
+  Engine* E = static_cast<Engine*>(h);
+  Validator& V = E->vals[vid];
+  std::string s = "era=" + std::to_string(V.era) +
+                  " own_mask=" + std::to_string((int)V.own_mask);
+  if (V.nhb) {
+    NHB* hb = V.nhb;
+    s += " hb{slots=" + std::to_string(hb->ct_slots.size()) + "/" +
+         std::to_string(hb->total_slots) +
+         " resolved=" + std::to_string(hb->resolved.size()) +
+         " inflight=" + std::to_string(hb->inflight.size()) +
+         " stash=" + std::to_string(hb->stash.size()) +
+         " queued=" + std::to_string((int)hb->queued) +
+         " done=" + std::to_string((int)hb->done) + "}";
+  }
+  int coins_open = 0;
+  for (auto& kv : V.ncoin)
+    if (!kv.second->done) coins_open++;
+  s += " coins=" + std::to_string(V.ncoin.size()) +
+       " coins_open=" + std::to_string(coins_open);
+  if (V.nroot) {
+    NRoot* r = V.nroot;
+    s += " root{hb_done=" + std::to_string((int)r->hb_done) +
+         " nonce=" + std::to_string(r->nonce_parity) +
+         " header=" + std::to_string((int)r->header_posted) +
+         " verified=" + std::to_string(r->verified_count) +
+         " pending=" + std::to_string(r->pending.size()) +
+         " early=" + std::to_string(r->early.size()) +
+         " produced=" + std::to_string((int)r->produced) + "}";
+  }
+  if (!buf || !cap) return s.size();
+  size_t ncopy = s.size() < cap ? s.size() : cap;
+  std::memcpy(buf, s.data(), ncopy);
+  return ncopy;
 }
 
 void rt_mute(void* h, int vid) { static_cast<Engine*>(h)->muted.set(vid); }

@@ -1,14 +1,20 @@
 """ctypes binding for the native consensus engine (libconsensus_rt).
 
 `NativeSimulatedNetwork` is a drop-in for `simulator.SimulatedNetwork`: the
-delivery queue and the flood protocols (BinaryBroadcast, BinaryAgreement,
-ReliableBroadcast, CommonSubset) run inside the C++ engine
-(native/consensus_rt.cpp), while every crypto-bearing protocol — CommonCoin,
-HoneyBadger, RootProtocol — remains the existing Python class, its messages
-crossing the engine as opaque payloads. The split keeps the Python crypto
-stack (and the TPU-batched era kernel it drives) as the single source of
-cryptographic truth while removing the Python per-message dispatch cost that
-dominated N=64 eras (benchmarks/results_r03.json: 479.5 s, 2.45 M messages).
+delivery queue and ALL seven consensus protocols run inside the C++ engine
+(native/consensus_rt.cpp). The flood protocols (BinaryBroadcast,
+BinaryAgreement, ReliableBroadcast, CommonSubset) are hosted wholesale; the
+crypto-bearing protocols (CommonCoin, HoneyBadger, RootProtocol) are split —
+the engine owns their MESSAGE state machines while Python host shims
+(native_hosts.py) own every cryptographic operation, reached through BATCHED
+boundary crossings instead of one Python round-trip per message. The Python
+protocol classes remain the pinned cryptographic oracle: a TAKE_FIRST run is
+bit-identical across engines (tests/test_native_rt.py).
+
+A validator whose `_extra_factories` overrides one of the crypto protocols
+(the malicious-subclass test pattern, or forcing the Python engines for
+debugging) keeps that protocol in Python: its ownership bit stays clear and
+its opaque messages keep flowing through the legacy per-message callback.
 
 Reference roles covered: AbstractProtocol's thread+queue runtime
 (/root/reference/src/Lachain.Consensus/AbstractProtocol.cs:11-168) and the
@@ -22,9 +28,29 @@ import subprocess
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Set
 
+from ..utils import metrics
 from . import messages as M
 from .era import EraRouter
 from .keys import PrivateConsensusKeys, PublicConsensusKeys
+from .native_hosts import (
+    RQ_COIN,
+    RQ_HB,
+    RQ_ROOT,
+    XO_COIN_COMBINE,
+    XO_COIN_RESULT,
+    XO_COIN_SIGN,
+    XO_HB_ACS,
+    XO_HB_DONE,
+    XO_HB_QUEUE,
+    XO_NAMES,
+    XO_ROOT_INPUT,
+    XO_ROOT_PRODUCE,
+    XO_ROOT_SIGN,
+    XO_ROOT_VERIFY,
+    CoinHost,
+    HoneyBadgerHost,
+    RootHost,
+)
 from .simulator import DeliveryMode
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
@@ -34,6 +60,15 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libconsensus_rt.so")
 KIND_DECRYPTED = 0
 KIND_SIGNED_HEADER = 1
 KIND_COIN = 2
+
+# per-validator native-ownership mask (consensus_rt.cpp enum OwnMask)
+OWN_HB = 1
+OWN_COIN = 2
+OWN_ROOT = 4
+
+# labeled counter of every engine->Python boundary crossing; op
+# "opaque_message" is the legacy per-message callback the batched ops replace
+CROSSINGS_METRIC = "consensus_callback_crossings_total"
 
 _OPAQUE_CB = ctypes.CFUNCTYPE(
     None,
@@ -58,6 +93,16 @@ _ACS_CB = ctypes.CFUNCTYPE(
 _COINREQ_CB = ctypes.CFUNCTYPE(
     None, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32
 )
+_CROSS_CB = ctypes.CFUNCTYPE(
+    None,
+    ctypes.c_int32,  # target
+    ctypes.c_int32,  # era
+    ctypes.c_int32,  # op (XO_*)
+    ctypes.c_int32,  # a
+    ctypes.c_int32,  # b
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_size_t,
+)
 
 _lib_cache: List[Any] = [None]
 
@@ -77,7 +122,7 @@ def load_rt():
         )
     lib = ctypes.CDLL(_LIB_PATH)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 1
+    assert lib.lt_crt_version() == 2
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -93,6 +138,41 @@ def load_rt():
         _OPAQUE_CB,
         _ACS_CB,
         _COINREQ_CB,
+        _CROSS_CB,
+    ]
+    lib.rt_set_owned.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.rt_set_coin_need.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rt_request.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.rt_post.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rt_hb_ready_export.restype = ctypes.c_size_t
+    lib.rt_hb_ready_export.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rt_native_handled.restype = ctypes.c_uint64
+    lib.rt_native_handled.argtypes = [ctypes.c_void_p]
+    lib.rt_debug_state.restype = ctypes.c_size_t
+    lib.rt_debug_state.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
     ]
     lib.rt_mute.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.rt_advance_era.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
@@ -133,20 +213,35 @@ def load_rt():
 
 @dataclass(frozen=True)
 class NativeCoinParent:
-    """Result address for a CommonCoin requested by a NATIVE BinaryAgreement:
+    """Result address for a PYTHON CommonCoin requested by a native
+    BinaryAgreement (the coin ownership bit is clear — override factory):
     the Python coin's emit_result routes back into the engine."""
 
     agreement: int
     epoch: int
 
 
-class NativeEraRouter(EraRouter):
-    """EraRouter whose flood protocols live in the native engine.
+class _EraHosts:
+    """Per-era container for the native-protocol host shims of one router."""
 
-    Python-side protocols (Root/HoneyBadger/CommonCoin) are created and routed
-    exactly as in EraRouter; requests addressed to natively-owned protocol ids
-    divert into the engine, and engine callbacks re-enter through
-    `_on_opaque` / `_on_acs_result` / `_on_coin_request`.
+    __slots__ = ("coins", "hb", "root", "py_parents")
+
+    def __init__(self):
+        self.coins: Dict[tuple, CoinHost] = {}
+        self.hb: Optional[HoneyBadgerHost] = None
+        self.root: Optional[RootHost] = None
+        # parent protocol ids of PYTHON protocols awaiting a native result
+        self.py_parents: Dict[Any, Any] = {}
+
+
+class NativeEraRouter(EraRouter):
+    """EraRouter whose protocols live in the native engine.
+
+    Flood protocols are engine-only. Crypto-bearing protocols are
+    engine-hosted with Python crypto shims (native_hosts.py) unless an
+    `_extra_factories` override forces the Python class — then requests and
+    messages route exactly as in EraRouter, crossing the engine as opaque
+    payloads via the legacy per-message callbacks.
     """
 
     def __init__(
@@ -157,6 +252,7 @@ class NativeEraRouter(EraRouter):
         private_keys: PrivateConsensusKeys,
         net: "NativeSimulatedNetwork",
         extra_factories=None,
+        journal=None,
     ):
         def _no_send(target, payload):  # pragma: no cover
             raise RuntimeError("native router transports via the engine")
@@ -168,9 +264,79 @@ class NativeEraRouter(EraRouter):
             private_keys,
             send=_no_send,
             extra_factories=extra_factories,
+            journal=journal,
         )
         self._net = net
         self._acs_parent: Any = None
+        self.crypto_batcher = None  # set by the network when batching is on
+        self._root_ctx = None  # (producer, ecdsa_priv, ecdsa_pubs)
+        self._era_hosts: Dict[int, _EraHosts] = {}
+        self._native_results: Dict[Any, Any] = {}
+
+    # -- native ownership ------------------------------------------------------
+    def _native_mask(self) -> int:
+        """Which crypto protocols THIS validator hosts natively. Computed
+        lazily (tests install override factories after construction) and
+        synced to the engine before any request enters it."""
+        mask = 0
+        if M.CoinId not in self._extra_factories:
+            mask |= OWN_COIN
+        if (
+            M.HoneyBadgerId not in self._extra_factories
+            and self.crypto_batcher is not None
+            and self._net._era_fn_available()
+        ):
+            mask |= OWN_HB
+        # native Root drives native HB + the native nonce coin; a validator
+        # running either of those in Python must run Root in Python too
+        if (
+            self._root_ctx is not None
+            and M.RootProtocolId not in self._extra_factories
+            and (mask & OWN_HB)
+            and (mask & OWN_COIN)
+        ):
+            mask |= OWN_ROOT
+        return mask
+
+    # -- host shims ------------------------------------------------------------
+    def _hosts(self, era: int) -> _EraHosts:
+        hs = self._era_hosts.get(era)
+        if hs is None:
+            hs = self._era_hosts[era] = _EraHosts()
+        return hs
+
+    def hb_host(self, era: int) -> HoneyBadgerHost:
+        hs = self._hosts(era)
+        if hs.hb is None:
+            hs.hb = HoneyBadgerHost(self, era)
+        return hs.hb
+
+    def coin_host(self, era: int, agreement: int, epoch: int) -> CoinHost:
+        hs = self._hosts(era)
+        key = (agreement, epoch)
+        host = hs.coins.get(key)
+        if host is None:
+            cid = M.CoinId(era=era, agreement=agreement, epoch=epoch)
+            host = hs.coins[key] = CoinHost(self, cid)
+        return host
+
+    def root_host(self, era: int) -> RootHost:
+        hs = self._hosts(era)
+        if hs.root is None:
+            producer, priv, pubs = self._root_ctx
+            hs.root = RootHost(self, era, producer, priv, pubs)
+        return hs.root
+
+    def _native_send(self, payload):
+        """Journal-aware emission half of EraRouter.broadcast for payloads
+        whose message state machine lives in the engine: durable-record
+        (possibly substituting previously recorded wire bytes — the
+        no-self-equivocation latch) + outbox, WITHOUT the transport send; the
+        caller hands the returned wire payload to the engine, which owns
+        delivery."""
+        payload = self._durable_send(None, payload)
+        self._record_outbox(None, payload)
+        return payload
 
     # -- outbound: divert into the engine -------------------------------------
     def internal_request(self, req: M.Request) -> None:
@@ -184,6 +350,29 @@ class NativeEraRouter(EraRouter):
             (M.BinaryAgreementId, M.BinaryBroadcastId, M.ReliableBroadcastId),
         ):
             raise RuntimeError(f"natively-owned protocol requested: {to}")
+        if getattr(to, "era", None) == self.era:
+            mask = self._native_mask()
+            if isinstance(to, M.RootProtocolId) and (mask & OWN_ROOT):
+                self._net._sync_owner(self._my_id)
+                self._net._rt_request(self._my_id, RQ_ROOT, 0, 0)
+                return
+            if isinstance(to, M.HoneyBadgerId) and (mask & OWN_HB):
+                self._net._sync_owner(self._my_id)
+                self._hosts(to.era).py_parents["hb"] = req.from_id
+                self._net._rt_request(self._my_id, RQ_HB, 0, 0)
+                if to in self._native_results:
+                    return  # done-replay: the result was re-routed already
+                self.hb_host(to.era).handle_input(req.input)
+                return
+            if isinstance(to, M.CoinId) and (mask & OWN_COIN):
+                self._net._sync_owner(self._my_id)
+                self._hosts(to.era).py_parents[
+                    ("coin", to.agreement, to.epoch)
+                ] = req.from_id
+                self._net._rt_request(
+                    self._my_id, RQ_COIN, to.agreement, to.epoch
+                )
+                return
         super().internal_request(req)
 
     def internal_response(self, res: M.Result) -> None:
@@ -202,6 +391,14 @@ class NativeEraRouter(EraRouter):
         super().internal_response(res)
 
     def broadcast(self, payload) -> None:
+        # python-side protocol emission: durable-record + outbox exactly as
+        # EraRouter.broadcast, then transport through the engine
+        payload = self._native_send(payload)
+        self._engine_transport(payload)
+
+    def _engine_transport(self, payload) -> None:
+        """Hand one host-shim payload to the engine for delivery (the
+        transport half of broadcast — no journaling, no outbox record)."""
         if isinstance(payload, M.DecryptedMessage):
             self._net._bcast_opaque(
                 self._my_id, KIND_DECRYPTED, payload.share_id, 0, payload.payload
@@ -224,6 +421,24 @@ class NativeEraRouter(EraRouter):
         else:
             raise TypeError(f"unexpected python-protocol payload {type(payload)}")
 
+    def replay_outbox(self, era: int, requester: int) -> int:
+        """Retransmission service over the engine transport. The engine only
+        floods (its receive paths are idempotent — repeated shares are
+        dropped by the per-sender latches), so a targeted replay request is
+        answered with a re-broadcast of the recorded payloads. The engine
+        runs the router's current era only; older eras' flood traffic is
+        engine-internal and already superseded by the decided block."""
+        if era != self.era:
+            return 0
+        payloads = self.outbox_payloads(era, requester)
+        for payload in payloads:
+            self._engine_transport(payload)
+        if payloads:
+            from ..utils import metrics
+
+            metrics.inc("consensus_outbox_replayed_total", len(payloads))
+        return len(payloads)
+
     def send_to(self, validator: int, payload) -> None:
         raise TypeError("python-side protocols only broadcast")
 
@@ -238,15 +453,51 @@ class NativeEraRouter(EraRouter):
             ),
         ):
             raise RuntimeError(f"natively-owned protocol id {pid}")
+        if (
+            isinstance(pid, M.RootProtocolId)
+            and type(pid) not in self._extra_factories
+            and self._root_ctx is not None
+        ):
+            # Root context was given natively (set_root_context) but this
+            # validator cannot own Root (an HB/Coin override forced Python):
+            # fall back to the Python RootProtocol built from the same context
+            from .root_protocol import RootProtocol
+
+            producer, priv, pubs = self._root_ctx
+            return RootProtocol(
+                pid, self, producer=producer, ecdsa_priv=priv, ecdsa_pubs=pubs
+            )
         return super()._create(pid)
+
+    def result_of(self, pid) -> Any:
+        if pid in self._native_results:
+            return self._native_results[pid]
+        return super().result_of(pid)
+
+    def native_state(self) -> str:
+        """Engine-side state of this validator's natively-owned protocols
+        (for watchdog stall reports)."""
+        return self._net.native_state_of(self._my_id)
 
     def advance_era(self, new_era: int) -> None:
         if new_era <= self.era:
             return
+        old_era = self.era
         super().advance_era(new_era)
+        # host shims and native results follow the same retention as
+        # protocol instances: keep the last active era, drop older
+        cutoff = min(new_era - 1, old_era)
+        for e in [e for e in self._era_hosts if e < cutoff]:
+            del self._era_hosts[e]
+        for pid in [
+            p
+            for p in self._native_results
+            if getattr(p, "era", new_era) < cutoff
+        ]:
+            del self._native_results[pid]
         self._net._advance_era(self._my_id, new_era)
 
-    # -- engine callbacks ------------------------------------------------------
+    # -- engine callbacks (legacy per-message path) ----------------------------
     def _on_opaque(
         self, sender: int, era: int, kind: int, agreement: int, epoch: int, data: bytes
     ) -> None:
@@ -289,6 +540,51 @@ class NativeEraRouter(EraRouter):
             )
         )
 
+    # -- engine callbacks (batched crossing path) ------------------------------
+    def _on_cross(self, era: int, op: int, a: int, b: int, blob: bytes) -> None:
+        if op == XO_COIN_SIGN:
+            self.coin_host(era, a, b).sign()
+        elif op == XO_COIN_COMBINE:
+            self.coin_host(era, a, b).combine(blob)
+        elif op == XO_COIN_RESULT:
+            # native coin completed for a PYTHON parent (or a direct request)
+            value = bool(blob[0]) if blob else False
+            cid = M.CoinId(era=era, agreement=a, epoch=b)
+            self._native_results[cid] = value
+            parent = self._hosts(era).py_parents.pop(("coin", a, b), None)
+            if parent is None:
+                self._net._request_stop()
+            else:
+                super().internal_response(
+                    M.Result(from_id=cid, to_id=parent, value=value)
+                )
+        elif op == XO_HB_ACS:
+            self.hb_host(era).on_acs(blob)
+        elif op == XO_HB_QUEUE:
+            self.hb_host(era).on_queue()
+        elif op == XO_HB_DONE:
+            result = self.hb_host(era).finish()
+            hbid = M.HoneyBadgerId(era=era)
+            self._native_results[hbid] = result
+            if a:  # parent is Python-side (or a direct top-level request)
+                parent = self._hosts(era).py_parents.pop("hb", None)
+                if parent is None:
+                    self._net._request_stop()
+                else:
+                    super().internal_response(
+                        M.Result(from_id=hbid, to_id=parent, value=result)
+                    )
+        elif op == XO_ROOT_INPUT:
+            self.root_host(era).on_input()
+        elif op == XO_ROOT_SIGN:
+            self.root_host(era).on_sign(a)
+        elif op == XO_ROOT_VERIFY:
+            self.root_host(era).on_verify(blob)
+        elif op == XO_ROOT_PRODUCE:
+            self.root_host(era).on_produce()
+        else:  # unknown op: refuse loudly — a silent drop would stall
+            raise RuntimeError(f"unknown native crossing op {op}")
+
 
 class NativeSimulatedNetwork:
     """Drop-in for simulator.SimulatedNetwork backed by the C++ engine."""
@@ -305,6 +601,7 @@ class NativeSimulatedNetwork:
         extra_factories=None,
         use_crypto_batcher: bool = True,
         fault_plan=None,
+        journals: Optional[List] = None,
     ):
         self.n = public_keys.n
         self.muted = set(muted or set())
@@ -358,6 +655,9 @@ class NativeSimulatedNetwork:
         )
         for v in self.muted:
             self._lib.rt_mute(self._h, v)
+        # threshold for the native coin's combine trigger (CommonCoin needs
+        # t+1 shares before a combine can possibly succeed)
+        self._lib.rt_set_coin_need(self._h, public_keys.ts_keys.t + 1)
         self.routers: List[NativeEraRouter] = [
             NativeEraRouter(
                 era=era,
@@ -366,6 +666,7 @@ class NativeSimulatedNetwork:
                 private_keys=private_keys[i],
                 net=self,
                 extra_factories=extra_factories,
+                journal=journals[i] if journals is not None else None,
             )
             for i in range(self.n)
         ]
@@ -375,6 +676,7 @@ class NativeSimulatedNetwork:
             _OPAQUE_CB(self._cb_opaque),
             _ACS_CB(self._cb_acs),
             _COINREQ_CB(self._cb_coinreq),
+            _CROSS_CB(self._cb_cross),
         )
         self._lib.rt_set_callbacks(self._h, *self._cbs)
         self.delivered_count = 0
@@ -388,6 +690,8 @@ class NativeSimulatedNetwork:
             self.crypto_batcher = TpkeEraBatcher()
             for r in self.routers:
                 r.crypto_batcher = self.crypto_batcher
+        self._own_masks = [-1] * self.n  # engine-side mask cache (-1 unset)
+        self._sync_ownership()
 
     def close(self) -> None:
         if self._h is not None:
@@ -399,6 +703,30 @@ class NativeSimulatedNetwork:
             self.close()
         except Exception:
             pass
+
+    # -- native ownership ------------------------------------------------------
+    def _era_fn_available(self) -> bool:
+        from ..crypto.provider import get_backend
+
+        return (
+            getattr(get_backend(), "tpke_era_verify_combine", None) is not None
+        )
+
+    def _sync_owner(self, vid: int) -> None:
+        mask = self.routers[vid]._native_mask()
+        if mask != self._own_masks[vid]:
+            self._own_masks[vid] = mask
+            self._lib.rt_set_owned(self._h, vid, mask)
+
+    def _sync_ownership(self) -> None:
+        for vid in range(self.n):
+            self._sync_owner(vid)
+
+    def set_root_context(self, vid: int, producer, ecdsa_priv, ecdsa_pubs) -> None:
+        """Give validator `vid` its block-production context so RootProtocol
+        can be hosted natively (the Python fallback uses the same context)."""
+        self.routers[vid]._root_ctx = (producer, ecdsa_priv, ecdsa_pubs)
+        self._sync_owner(vid)
 
     # -- engine entry points ---------------------------------------------------
     def _post_acs_input(self, vid: int, data: bytes) -> None:
@@ -416,6 +744,39 @@ class NativeSimulatedNetwork:
             self._h, vid, kind, agreement, epoch, data, len(data)
         )
 
+    def _rt_request(self, vid: int, kind: int, a: int, b: int) -> None:
+        self._lib.rt_request(self._h, vid, kind, a, b)
+        err = self._cb_error
+        if err is not None:
+            # a request posted OUTSIDE run() (post_request path) can recurse
+            # through the engine into host code; surface its failure now
+            self._cb_error = None
+            raise err
+
+    def _rt_post(self, vid: int, op: int, a: int, b: int, data: bytes = b"") -> None:
+        self._lib.rt_post(self._h, vid, op, a, b, data, len(data))
+
+    def _rt_hb_export(self, vid: int) -> bytes:
+        size = self._lib.rt_hb_ready_export(self._h, vid, None, 0)
+        if not size:
+            return b""
+        buf = ctypes.create_string_buffer(size)
+        self._lib.rt_hb_ready_export(self._h, vid, buf, size)
+        return buf.raw[:size]
+
+    def native_state_of(self, vid: int) -> str:
+        size = self._lib.rt_debug_state(self._h, vid, None, 0)
+        if not size:
+            return ""
+        buf = ctypes.create_string_buffer(size)
+        self._lib.rt_debug_state(self._h, vid, buf, size)
+        return buf.raw[:size].decode("utf-8", "replace")
+
+    def native_handled(self) -> int:
+        """Messages the engine consumed natively that PREVIOUSLY each cost a
+        per-message Python callback — the eliminated crossings."""
+        return int(self._lib.rt_native_handled(self._h))
+
     def _advance_era(self, vid: int, era: int) -> None:
         self._lib.rt_advance_era(self._h, vid, era)
 
@@ -432,6 +793,7 @@ class NativeSimulatedNetwork:
         if self._cb_error is not None:
             return
         try:
+            metrics.inc(CROSSINGS_METRIC, labels={"op": "opaque_message"})
             blob = ctypes.string_at(data, length) if length else b""
             self.routers[target]._on_opaque(
                 sender, era, kind, agreement, epoch, blob
@@ -452,6 +814,7 @@ class NativeSimulatedNetwork:
         if self._cb_error is not None:
             return
         try:
+            metrics.inc(CROSSINGS_METRIC, labels={"op": "acs_result"})
             result = {
                 int(slots[i]): (
                     ctypes.string_at(datas[i], lens[i]) if lens[i] else b""
@@ -466,12 +829,27 @@ class NativeSimulatedNetwork:
         if self._cb_error is not None:
             return
         try:
+            metrics.inc(CROSSINGS_METRIC, labels={"op": "coin_request"})
             self.routers[target]._on_coin_request(era, agreement, epoch)
+        except BaseException as exc:  # noqa: BLE001
+            self._cb_error = exc
+
+    def _cb_cross(self, target, era, op, a, b, data, length):
+        if self._cb_error is not None:
+            return
+        try:
+            metrics.inc(
+                CROSSINGS_METRIC,
+                labels={"op": XO_NAMES.get(op, f"op{op}")},
+            )
+            blob = ctypes.string_at(data, length) if length else b""
+            self.routers[target]._on_cross(era, op, a, b, blob)
         except BaseException as exc:  # noqa: BLE001
             self._cb_error = exc
 
     # -- execution (simulator.py::run contract) --------------------------------
     def post_request(self, validator: int, pid, value) -> None:
+        self._sync_ownership()
         self.routers[validator].internal_request(
             M.Request(from_id=None, to_id=pid, input=value)
         )
@@ -482,34 +860,42 @@ class NativeSimulatedNetwork:
         max_messages: int = 1_000_000,
         chunk: int = 16384,
     ) -> bool:
-        while not done():
-            processed = self._lib.rt_run(self._h, chunk)
-            self.delivered_count += processed
-            if self._cb_error is not None:
-                err, self._cb_error = self._cb_error, None
-                raise err
-            if (
-                self.crypto_batcher is not None
-                and self.crypto_batcher.pending
-                and (
-                    self._lib.rt_queue_len(self._h) == 0
-                    or self._lib.rt_opaque_pending(self._h, KIND_DECRYPTED)
-                    == 0
-                )
-            ):
-                self.crypto_batcher.flush()
-                continue
-            if processed == 0:
-                return done()
-            if (
-                self.delivered_count >= max_messages
-                and self._lib.rt_queue_len(self._h) > 0
-                and not done()
-            ):
-                raise RuntimeError(
-                    f"message cap {max_messages} exceeded — livelock?"
-                )
-        return True
+        try:
+            while not done():
+                processed = self._lib.rt_run(self._h, chunk)
+                self.delivered_count += processed
+                if self._cb_error is not None:
+                    err, self._cb_error = self._cb_error, None
+                    raise err
+                if (
+                    self.crypto_batcher is not None
+                    and self.crypto_batcher.pending
+                    and (
+                        self._lib.rt_queue_len(self._h) == 0
+                        or self._lib.rt_opaque_pending(self._h, KIND_DECRYPTED)
+                        == 0
+                    )
+                ):
+                    self.crypto_batcher.flush()
+                    if self._cb_error is not None:
+                        err, self._cb_error = self._cb_error, None
+                        raise err
+                    continue
+                if processed == 0:
+                    return done()
+                if (
+                    self.delivered_count >= max_messages
+                    and self._lib.rt_queue_len(self._h) > 0
+                    and not done()
+                ):
+                    raise RuntimeError(
+                        f"message cap {max_messages} exceeded — livelock?"
+                    )
+            return True
+        finally:
+            metrics.set_gauge(
+                "consensus_native_handled_messages", self.native_handled()
+            )
 
     def results(self, pid) -> List[Any]:
         return [r.result_of(pid) for r in self.routers]

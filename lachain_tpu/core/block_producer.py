@@ -33,11 +33,24 @@ def encode_tx_batch(txs: Sequence[SignedTransaction]) -> bytes:
     return write_bytes_list([t.encode() for t in txs])
 
 
+# decoded-proposal memo: in-process multi-validator harnesses hand the SAME
+# proposal bytes to every validator (N=64 -> 64x64 identical decodes per
+# era), and sharing the immutable SignedTransaction objects also shares
+# their hash/sender caches. Bounded FIFO keyed by the raw wire bytes.
+_DECODE_MEMO: dict = {}
+_DECODE_MEMO_MAX = 256
+
+
 def decode_tx_batch(data: bytes) -> List[SignedTransaction]:
-    r = Reader(data)
-    out = [SignedTransaction.decode(b) for b in r.bytes_list()]
-    r.assert_eof()
-    return out
+    cached = _DECODE_MEMO.get(data)
+    if cached is None:
+        r = Reader(data)
+        cached = tuple(SignedTransaction.decode(b) for b in r.bytes_list())
+        r.assert_eof()
+        if len(_DECODE_MEMO) >= _DECODE_MEMO_MAX:
+            _DECODE_MEMO.pop(next(iter(_DECODE_MEMO)))
+        _DECODE_MEMO[data] = cached
+    return list(cached)
 
 
 class BlockProducer:

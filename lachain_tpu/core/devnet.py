@@ -137,9 +137,20 @@ class Devnet:
             **net_kw,
         )
         for i, router in enumerate(self.net.routers):
-            router._extra_factories[M.RootProtocolId] = root_factory_for(
-                self.nodes[i]
-            )
+            if engine == "native":
+                # native engine: hand each validator its block-production
+                # context so RootProtocol is hosted natively (an
+                # _extra_factories override still forces the Python class)
+                self.net.set_root_context(
+                    i,
+                    self.nodes[i].producer,
+                    self.private_keys[i].ecdsa_priv,
+                    self.public_keys.ecdsa_pub_keys,
+                )
+            else:
+                router._extra_factories[M.RootProtocolId] = root_factory_for(
+                    self.nodes[i]
+                )
 
     @staticmethod
     def _nonce_reader(state: StateManager):

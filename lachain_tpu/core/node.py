@@ -140,6 +140,8 @@ class Node:
         # amplification attack
         self.replay_min_interval = 2.0
         self._replay_served_at: Dict[tuple, float] = {}
+        # native-engine stall detector state: (last_state_string, since, strikes)
+        self._native_watch: tuple = ("", 0.0, 0)
         self.validator_manager = ValidatorManager(self.state, public_keys)
         from .fast_sync import FastSynchronizer
 
@@ -312,6 +314,17 @@ class Node:
             if router is None:
                 continue
             now = _time.monotonic()
+            # natively-owned protocols have no python instance in
+            # router._protocols — their only stall signal is the engine's
+            # debug state; snapshot it once per sweep so every stall report
+            # this sweep can name the engine side too
+            native_state = ""
+            nstate_fn = getattr(router, "native_state", None)
+            if nstate_fn is not None:
+                try:
+                    native_state = nstate_fn()
+                except Exception:  # engine may be torn down mid-sweep
+                    native_state = "<unavailable>"
             # aggregate the ladder per era: one sweep re-requests/reconnects
             # once, however many of the era's protocols are stalled
             era_stage: Dict[int, int] = {}
@@ -325,13 +338,16 @@ class Node:
                     stage = proto.record_stall()
                     logger.warning(
                         "protocol %s stalled for %.0fs (alive %.0fs, "
-                        "strike %d, last message: %s, open spans: %s)",
+                        "strike %d, last message: %s, open spans: %s%s)",
                         pid,
                         stalled,
                         now - proto.started_at,
                         stage,
                         proto.last_message,
                         tracing.open_stack_str(),
+                        f", native engine: {native_state}"
+                        if native_state
+                        else "",
                     )
                     tracing.instant(
                         "watchdog_stall",
@@ -340,12 +356,60 @@ class Node:
                         stalled_s=round(stalled, 1),
                         stage=stage,
                         last_message=proto.last_message,
+                        native_state=native_state,
                     )
                     proto.last_activity = now  # re-arm, don't spam
                     era = getattr(pid, "era", router.era)
                     era_stage[era] = max(era_stage.get(era, 0), stage)
+            if nstate_fn is not None:
+                stage = self._check_native_stall(router, native_state, now)
+                if stage:
+                    era_stage[router.era] = max(
+                        era_stage.get(router.era, 0), stage
+                    )
             for era, stage in era_stage.items():
                 self._escalate_stall(era, stage)
+
+    def _check_native_stall(self, router, native_state: str, now) -> int:
+        """Stall detection for engine-hosted protocols: no python instance
+        means no last_activity to age, so a natively-owned protocol id
+        stalls silently unless the engine's debug state is watched. The
+        state string encodes per-protocol progress (queue depths, epochs,
+        inflight slots), so 'unchanged for stall_timeout while the era has
+        no result' is the native analogue of a quiet protocol — report it
+        naming the engine state and feed the same escalation ladder."""
+        prev_state, mark, strikes = self._native_watch
+        if native_state != prev_state or not native_state:
+            self._native_watch = (native_state, now, 0)
+            return 0
+        if now - mark <= self.stall_timeout:
+            return 0
+        if router.result_of(M.RootProtocolId(era=router.era)) is not None:
+            # era complete on our side; quiet engine state is expected
+            self._native_watch = (native_state, now, 0)
+            return 0
+        from ..utils import tracing
+
+        strikes += 1
+        logger.warning(
+            "native engine stalled for %.0fs in era %d (strike %d, "
+            "engine state: %s)",
+            now - mark,
+            router.era,
+            strikes,
+            native_state,
+        )
+        tracing.instant(
+            "watchdog_stall",
+            cat="watchdog",
+            pid=f"native:era{router.era}",
+            stalled_s=round(now - mark, 1),
+            stage=strikes,
+            last_message="",
+            native_state=native_state,
+        )
+        self._native_watch = (native_state, now, strikes)  # re-arm
+        return strikes
 
     def _escalate_stall(self, era: int, stage: int) -> None:
         """Stage 2+: ask every live peer to replay its outbox for `era`

@@ -2463,6 +2463,48 @@ int lt_g2_mul(const uint8_t in[192], const uint8_t scalar[32],
   return 0;
 }
 
+// n independent G1 scalar muls (out[i] = pts[i] * scalars[i]) partitioned
+// across threads — the TPKE decrypt-share shape: one node emits U^{x_i} for
+// every ready ACS slot in one era tick, and per-call ctypes+spawn overhead
+// would eat the win mul-by-mul. nthreads <= 1 or tiny n stays serial.
+// returns 0 ok; 1 bad point encoding.
+int lt_g1_mul_batch(const uint8_t *pts, const uint8_t *scalars, size_t n,
+                    int nthreads, uint8_t *out) {
+  if (nthreads <= 1 || n < 8) {
+    for (size_t i = 0; i < n; i++) {
+      G1 p;
+      if (!g1_from_bytes(p, pts + i * 96)) return 1;
+      G1 r;
+      g1_mul_scalar(r, p, scalars + i * 32, 32);
+      g1_to_bytes(out + i * 96, r);
+    }
+    return 0;
+  }
+  if ((size_t)nthreads > n / 2) nthreads = (int)(n / 2);
+  std::vector<int> bad(nthreads, 0);
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    size_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    ts.emplace_back([&, t, lo, hi]() {
+      for (size_t i = lo; i < hi; i++) {
+        G1 p;
+        if (!g1_from_bytes(p, pts + i * 96)) {
+          bad[t] = 1;
+          return;
+        }
+        G1 r;
+        g1_mul_scalar(r, p, scalars + i * 32, 32);
+        g1_to_bytes(out + i * 96, r);
+      }
+    });
+  }
+  for (auto &th : ts) th.join();
+  for (int t = 0; t < nthreads; t++)
+    if (bad[t]) return 1;
+  return 0;
+}
+
 int lt_g1_add(const uint8_t a[96], const uint8_t b[96], uint8_t out[96]) {
   G1 pa, pb;
   if (!g1_from_bytes(pa, a) || !g1_from_bytes(pb, b)) return 1;

@@ -81,6 +81,30 @@ class NativeBackend:
             raise ValueError("native g1_mul failed")
         return bls.g1_from_bytes(out.raw, check_subgroup=False)
 
+    def g1_mul_batch(
+        self, points: Sequence[tuple], scalars: Sequence[int]
+    ) -> List[tuple]:
+        """n independent muls in one threaded native call (NOT an MSM — no
+        accumulation). The TPKE decrypt-share shape: 64 slots x one
+        U^{x_i} each per era tick."""
+        if len(points) != len(scalars):
+            raise ValueError("g1_mul_batch: length mismatch")
+        if not points:
+            return []
+        pts = b"".join(bls.g1_to_bytes(p) for p in points)
+        ss = b"".join(_scalar32(s) for s in scalars)
+        out = ctypes.create_string_buffer(96 * len(points))
+        nt = min(os.cpu_count() or 1, 16)
+        rc = self._lib.lt_g1_mul_batch(pts, ss, len(points), nt, out)
+        if rc != 0:
+            raise ValueError("native g1_mul_batch failed")
+        return [
+            bls.g1_from_bytes(
+                out.raw[i * 96 : (i + 1) * 96], check_subgroup=False
+            )
+            for i in range(len(points))
+        ]
+
     def g2_mul(self, point: tuple, scalar: int) -> tuple:
         out = ctypes.create_string_buffer(192)
         rc = self._lib.lt_g2_mul(

@@ -138,6 +138,20 @@ class Trie:
         for k, _ in items:
             self._pending.pop(k, None)
 
+    def export_pending(self) -> Dict[bytes, bytes]:
+        """Snapshot of the buffered node writes, for replaying into another
+        trie over the SAME chain (cross-validator emulation sharing): nodes
+        are content-addressed, so absorbing a snapshot taken after an
+        identical state transition hands the consumer exactly the nodes its
+        own freeze would have buffered."""
+        return dict(self._pending)
+
+    def absorb_pending(self, nodes: Dict[bytes, bytes]) -> None:
+        """Adopt another trie's exported node buffer (see export_pending).
+        Re-absorbing an already-persisted node is harmless — same key, same
+        encoding — it just rides the next commit batch again."""
+        self._pending.update(nodes)
+
     def clear_cache(self) -> None:
         self._cache.clear()
 

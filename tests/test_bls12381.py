@@ -125,3 +125,6 @@ def test_group_interpolation():
     g2_pts = [bls.g2_mul(bls.G2_GEN, bls.fr_eval_poly(coeffs, x)) for x in xs]
     combined2 = bls.g2_interpolate(xs, g2_pts, at=0)
     assert bls.g2_eq(combined2, bls.g2_mul(bls.G2_GEN, coeffs[0]))
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

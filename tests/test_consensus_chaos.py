@@ -61,6 +61,36 @@ def test_lossy_network_is_bit_identical_across_runs():
     assert runs[0] == runs[1]
 
 
+def test_lossy_plus_partition_is_bit_identical_across_runs():
+    """Determinism of the FULL transcript under the hardest combined plan:
+    loss forcing outbox-replay recovery AND a quorum-splitting partition
+    forcing quiescence-jump across the heal. Same seed -> identical block
+    hashes, delivered count, and fault tally on both runs."""
+    plan = FaultPlan(
+        seed=17,
+        drop=0.08,
+        duplicate=0.04,
+        reorder=0.04,
+        partitions=(
+            Partition(frozenset({0, 1}), frozenset({2, 3}), at=40, heal=500),
+        ),
+    )
+    runs = []
+    for _ in range(2):
+        d, blocks = run_chaos_devnet(plan)
+        runs.append(
+            (
+                [b.hash() for b in blocks],
+                d.net.delivered_count,
+                dict(d.net.faults.stats),
+            )
+        )
+    assert runs[0] == runs[1]
+    # both fault classes actually fired
+    assert runs[0][2]["dropped"] > 0
+    assert runs[0][2]["blocked"] > 0
+
+
 def test_delayed_messages_still_decide():
     plan = FaultPlan(seed=9, delay=0.10, delay_span=(1.0, 64.0))
     d, blocks = run_chaos_devnet(plan, eras=1)

@@ -8,6 +8,7 @@ import random
 
 from lachain_tpu.crypto import ecdsa as ec
 from lachain_tpu.crypto.hashes import keccak256
+import pytest
 
 
 class Rng:
@@ -251,3 +252,6 @@ def test_wallet_roundtrip_without_cryptography_package():
     key = bytes(range(32))
     blob = ecdsa.aes_gcm_encrypt(key, b"wallet-payload" * 20)
     assert ecdsa.aes_gcm_decrypt(key, blob) == b"wallet-payload" * 20
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -4,6 +4,7 @@ Merkle shape mirrors the reference's MerkleTree usage in ReliableBroadcast
 (/root/reference/src/Lachain.Consensus/ReliableBroadcast/ReliableBroadcast.cs:296-309).
 """
 from lachain_tpu.crypto import hashes
+import pytest
 
 
 def test_keccak256_vectors():
@@ -66,3 +67,6 @@ def test_native_keccak_matches_python():
     for size in (0, 1, 31, 32, 135, 136, 137, 1000, 5000):
         data = rng.randbytes(size)
         assert keccak256(data) == _keccak256_py(data)
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -159,3 +159,6 @@ def test_g1_msm_jits():
     out1 = f(pd, bits)
     out2 = f(pd, bits)  # cached call
     assert np.array_equal(np.asarray(out1), np.asarray(out2))
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -133,3 +133,6 @@ def test_source_hash_changes_key(cache_dir, monkeypatch):
     monkeypatch.setattr(kernel_cache, "_src_hash_cache", ["deadbeef"])
     k2 = kernel_cache._key("n", (), {})
     assert k1 != k2
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -191,3 +191,6 @@ def test_glv_era_pipeline_end_to_end():
     for s, (ct, _, _, msg) in enumerate(slots_raw):
         pad = tpke._pad(aggs[s][2], len(ct.v))
         assert bytes(a ^ b for a, b in zip(ct.v, pad)) == msg
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

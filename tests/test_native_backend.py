@@ -148,3 +148,6 @@ def test_threaded_pairing_check_matches_serial():
     g1s[5 * 96 : 6 * 96] = b"\xff" * 96
     g2s = b"".join(bls.g2_to_bytes(q) for _, q in pairs)
     assert b._lib.lt_pairing_check_mt(bytes(g1s), g2s, len(pairs), 3) == -1
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -137,3 +137,6 @@ def test_degenerate_recover_on_chip():
     h, sig = _degenerate_sig()
     got = psecp.TpuEcdsaRecover().recover_batch([h], [sig])
     assert got == [ecdsa.recover_hash(h, sig)]
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

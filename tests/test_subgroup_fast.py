@@ -212,3 +212,6 @@ def test_native_check_matches_certificate_fixtures():
         assert T is not None
         with pytest.raises(ValueError):
             backend.g1_deserialize(bls.g1_to_bytes(T))
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

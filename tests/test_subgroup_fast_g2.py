@@ -206,3 +206,6 @@ def test_native_g2_check_matches():
             forged = bls.g2_add(bls.g2_mul(bls.G2_GEN, 31337), tor)
             with pytest.raises(ValueError):
                 backend.g2_deserialize(bls.g2_to_bytes(forged))
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

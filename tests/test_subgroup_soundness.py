@@ -61,3 +61,6 @@ def test_batch_deserialize_g2_rejects_malformed():
     bad[5] ^= 0x42
     out = deserialize_batch_g2([good, bytes(bad)])
     assert out[0] is not None and out[1] is None
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

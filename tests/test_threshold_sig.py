@@ -147,3 +147,6 @@ def test_pubkeyset_serialization(keys):
     assert pks.verify_share(msg, ps)
     ps2 = ts.PartialSignature.from_bytes(ps.to_bytes())
     assert ps2.signer_id == 2 and bls.g2_eq(ps2.sigma, ps.sigma)
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

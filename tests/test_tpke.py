@@ -114,3 +114,6 @@ def test_key_serialization(keys):
     assert sk2.x_i == sk.x_i and sk2.my_id == 4
     vk2 = tpke.TpkeVerificationKey.from_bytes(keys.verification_keys[1].to_bytes())
     assert bls.g1_eq(vk2.y_i, keys.verification_keys[1].y_i)
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

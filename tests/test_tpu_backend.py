@@ -314,3 +314,6 @@ def test_adaptive_device_msm_routing(tpu_backend, monkeypatch):
     tpu_backend.min_device_lanes = 64
     tpu_backend.g1_msm(pts1, ss)
     assert calls == [False, True]
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

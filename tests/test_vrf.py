@@ -7,6 +7,7 @@ import random
 
 from lachain_tpu.crypto import ecdsa as ec
 from lachain_tpu.crypto import vrf
+import pytest
 
 
 class Rng:
@@ -80,3 +81,6 @@ def test_lottery_edges():
     # huge stake values don't blow up (wei-scale)
     big = 10**24
     assert isinstance(vrf.is_winner(beta, big, 4 * big, 22), bool)
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel

@@ -27,3 +27,6 @@ def test_warmup_noop_on_host_backend():
     from lachain_tpu.crypto.provider import PythonBackend
 
     assert warmup_era_kernels(4, backend=PythonBackend()) is None
+
+# slice marker: crypto/accelerator kernels ("make test-kernel")
+pytestmark = pytest.mark.kernel
