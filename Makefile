@@ -7,7 +7,7 @@
 PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
-.PHONY: test test-kernel test-fast test-chaos native bench
+.PHONY: test test-kernel test-fast test-chaos test-storage native bench
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -24,6 +24,13 @@ test-fast:
 # crash-point injection, SIGKILL-restart recovery
 test-chaos:
 	$(PYTEST) $(PYTEST_ARGS) -m "chaos or crash or slow"
+
+# durable-store engines: LSM differential/crash/compaction tests, trie +
+# state snapshots, crash-point matrix, fsck, CLI db verbs. Overlaps the
+# other slices on purpose — it is the slice to run after storage changes
+# (tests/native/sanitize.sh re-runs the non-slow part under ASan/UBSan)
+test-storage:
+	$(PYTEST) $(PYTEST_ARGS) -m storage
 
 test:
 	$(PYTEST) $(PYTEST_ARGS)

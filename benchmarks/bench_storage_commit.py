@@ -1,10 +1,14 @@
 """Block-commit latency at 10k txs on the durable store (VERDICT r3 #7).
 
 Drives the REAL commit path — order/emulate/execute_block with trie updates,
-receipts, blooms and the fsynced sqlite batch — for a 10,000-transfer block,
-and the raw write_batch throughput underneath it. Prints ONE JSON line.
+receipts, blooms and the fsynced batch — for a 10,000-transfer block, plus
+the raw write_batch throughput underneath it, on EVERY engine in one run so
+the two figures are from the same process/box and directly comparable.
+Prints ONE JSON object: a row per engine (tagged with "engine") and a
+"winner" summary keyed on the commit latency.
 
 Usage: python benchmarks/bench_storage_commit.py [--txs 10000]
+       [--engines sqlite,lsm]
 """
 from __future__ import annotations
 
@@ -27,61 +31,60 @@ class Rng:
         return self._r.randrange(n)
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--txs", type=int, default=10_000)
-    ap.add_argument("--store", choices=["sqlite", "lsm"], default="sqlite")
-    args = ap.parse_args()
-
-    from lachain_tpu.core import system_contracts
-    from lachain_tpu.core.block_manager import BlockManager
+def _make_txs(n_txs: int, chain: int):
     from lachain_tpu.core.types import (
-        BlockHeader,
-        MultiSig,
         Transaction,
         sign_transaction,
-        tx_merkle_root,
         warm_sender_caches,
     )
     from lachain_tpu.crypto import ecdsa
+
+    users = [ecdsa.generate_private_key(Rng(3 + i)) for i in range(64)]
+    addrs = [
+        ecdsa.address_from_public_key(ecdsa.public_key_bytes(u))
+        for u in users
+    ]
+    txs = []
+    per_user = (n_txs + len(users) - 1) // len(users)
+    for priv in users:
+        for n in range(per_user):
+            if len(txs) >= n_txs:
+                break
+            txs.append(
+                sign_transaction(
+                    Transaction(
+                        to=b"\x09" * 20,
+                        value=1,
+                        nonce=n,
+                        gas_price=1,
+                        gas_limit=21000,
+                    ),
+                    priv,
+                    chain,
+                )
+            )
+    warm_sender_caches(txs, chain)
+    return txs, addrs
+
+
+def bench_engine(engine: str, txs, addrs, chain: int) -> dict:
+    """One full commit-path measurement on a fresh store of `engine`."""
+    from lachain_tpu.core import system_contracts
+    from lachain_tpu.core.block_manager import BlockManager
+    from lachain_tpu.core.types import BlockHeader, MultiSig, tx_merkle_root
     from lachain_tpu.storage.kv import SqliteKV
     from lachain_tpu.storage.lsm import LsmKV
     from lachain_tpu.storage.state import StateManager
 
-    chain = 515
-    users = [ecdsa.generate_private_key(Rng(3 + i)) for i in range(64)]
-    addrs = [ecdsa.address_from_public_key(ecdsa.public_key_bytes(u)) for u in users]
-
     with tempfile.TemporaryDirectory() as tmp:
         kv = (
             LsmKV(os.path.join(tmp, "bench.lsm"))
-            if args.store == "lsm"
+            if engine == "lsm"
             else SqliteKV(os.path.join(tmp, "bench.db"))
         )
         state = StateManager(kv)
         bm = BlockManager(kv, state, system_contracts.make_executer(chain))
         bm.build_genesis({a: 10**24 for a in addrs}, chain)
-
-        txs = []
-        per_user = (args.txs + len(users) - 1) // len(users)
-        for u, priv in enumerate(users):
-            for n in range(per_user):
-                if len(txs) >= args.txs:
-                    break
-                txs.append(
-                    sign_transaction(
-                        Transaction(
-                            to=b"\x09" * 20,
-                            value=1,
-                            nonce=n,
-                            gas_price=1,
-                            gas_limit=21000,
-                        ),
-                        priv,
-                        chain,
-                    )
-                )
-        warm_sender_caches(txs, chain)
 
         ordered = bm.order_transactions(txs, chain)
         t0 = time.perf_counter()
@@ -97,6 +100,7 @@ def main() -> None:
         t0 = time.perf_counter()
         bm.execute_block(header, ordered, MultiSig(()), check_state_hash=True)
         t_commit = time.perf_counter() - t0
+        state_root = em.state_hash.hex()
 
         # raw fsynced batch throughput under the same store
         payload = [(b"raw:%d" % i, b"\xab" * 256) for i in range(10_000)]
@@ -105,24 +109,58 @@ def main() -> None:
         t_raw = time.perf_counter() - t0
         kv.close()
 
-    print(
-        json.dumps(
-            {
-                "metric": "block_commit_latency_s",
-                "value": round(t_commit, 3),
-                "unit": f"s per {len(txs)}-tx block commit (execute+trie+fsync)",
-                "txs": len(txs),
-                "emulate_s": round(t_emulate, 3),
-                "tx_per_s_commit": round(len(txs) / t_commit, 1),
-                "raw_batch_10k_puts_s": round(t_raw, 3),
-                "store": (
-                    "LsmKV native WAL+SST engine"
-                    if args.store == "lsm"
-                    else "SqliteKV WAL synchronous=FULL batches"
-                ),
-            }
-        )
+    return {
+        "engine": engine,
+        "metric": "block_commit_latency_s",
+        "value": round(t_commit, 3),
+        "unit": f"s per {len(txs)}-tx block commit (execute+trie+fsync)",
+        "txs": len(txs),
+        "emulate_s": round(t_emulate, 3),
+        "tx_per_s_commit": round(len(txs) / t_commit, 1),
+        "raw_batch_10k_puts_s": round(t_raw, 3),
+        "state_root": state_root,
+        "store": (
+            "LsmKV native skiplist+pipelined-WAL+SST engine"
+            if engine == "lsm"
+            else "SqliteKV WAL synchronous=FULL batches"
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--txs", type=int, default=10_000)
+    ap.add_argument(
+        "--engines",
+        default="sqlite,lsm",
+        help="comma-separated engine list, each benched on a fresh store",
     )
+    args = ap.parse_args()
+
+    chain = 515
+    txs, addrs = _make_txs(args.txs, chain)
+    rows = [
+        bench_engine(e.strip(), txs, addrs, chain)
+        for e in args.engines.split(",")
+        if e.strip()
+    ]
+    out: dict = {"rows": rows}
+    if len(rows) > 1:
+        best = min(rows, key=lambda r: r["value"])
+        rest = [r for r in rows if r is not best]
+        out["winner"] = {
+            "engine": best["engine"],
+            "value": best["value"],
+            "speedup_vs": {
+                r["engine"]: round(r["value"] / best["value"], 2)
+                for r in rest
+            },
+            # both engines drove the identical block: the roots must agree
+            "state_roots_identical": len(
+                {r["state_root"] for r in rows}
+            ) == 1,
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -13,9 +13,11 @@ composition):
       the autonomous era lifecycle.
   lachain-tpu height --config netdir/config0.json
       one-shot local status (height + validator set) without RPC.
-  lachain-tpu db shrink|rollback --config ...
-      offline store maintenance (prune checkpoints / restore a snapshot;
-      reference `db` verb + --RollBackTo, Application.cs:119-127).
+  lachain-tpu db shrink|rollback|compact|export|import --config ...
+      offline store maintenance (prune checkpoints / restore a snapshot /
+      LSM full merge / engine-portable dump + load — the sqlite<->lsm
+      migration path; reference `db` verb + --RollBackTo,
+      Application.cs:119-127).
   lachain-tpu encrypt|decrypt --wallet ...
       wallet re-keying / decrypted inspection (reference encrypt/decrypt).
   lachain-tpu console --rpc http://127.0.0.1:7071
@@ -132,6 +134,10 @@ def cmd_keygen(args) -> int:
             # library defaults (migrated configs get the NEVER sentinel
             # instead, core/config.py _v5_to_v6)
             "hardfork": {"heights": {"fast_wasm_gas": 0}},
+            # written explicitly for the same reason: the engine a chain's
+            # database is created with is permanent (migrated <=v6 configs
+            # get sqlite pinned instead, core/config.py _v6_to_v7)
+            "storage": {"engine": "lsm"},
         }
         path = os.path.join(args.out, f"config{i}.json")
         with open(path, "w") as fh:
@@ -567,12 +573,18 @@ def cmd_height(args) -> int:
     return 0
 
 
+_DB_DUMP_MAGIC = b"LKVD0001"
+
+
 def cmd_db(args) -> int:
-    """Offline database maintenance: shrink (prune old trie checkpoints)
-    and rollback (restore an older snapshot) — reference `lachain db` verbs
-    + --RollBackTo (Program.cs:25-39, Application.cs:119-127). The node
-    must be STOPPED: both operations mutate the store non-transactionally
-    with respect to concurrent commits (storage/shrink.py docstring)."""
+    """Offline database maintenance: shrink (prune old trie checkpoints),
+    rollback (restore an older snapshot) — reference `lachain db` verbs
+    + --RollBackTo (Program.cs:25-39, Application.cs:119-127) — plus
+    compact (LSM full merge), and export/import (engine-portable dump;
+    the supported migration path between storage engines, since sqlite and
+    LSM on-disk formats are not interchangeable). The node must be
+    STOPPED: these operations mutate or snapshot the store
+    non-transactionally with respect to concurrent commits."""
     from .core.config import NodeConfig
     from .storage.kv import SqliteKV
     from .storage.lsm import LsmKV
@@ -583,27 +595,89 @@ def cmd_db(args) -> int:
     db_path = cfg.storage_path or (
         os.path.splitext(args.config)[0] + ".db"
     )
+    make_kv = LsmKV if cfg.storage_engine == "lsm" else SqliteKV
+
+    if args.db_cmd == "import":
+        # target must be FRESH: importing over live state would interleave
+        # two chains' keys into one store
+        if os.path.exists(db_path):
+            print(f"refusing import: {db_path} already exists", file=sys.stderr)
+            return 1
+        count = 0
+        kv = make_kv(db_path)
+        try:
+            with open(args.dump, "rb") as fh:
+                if fh.read(len(_DB_DUMP_MAGIC)) != _DB_DUMP_MAGIC:
+                    print(f"{args.dump}: not a db export", file=sys.stderr)
+                    return 1
+                batch = []
+                while True:
+                    head = fh.read(4)
+                    if not head:
+                        break
+                    klen = int.from_bytes(head, "little")
+                    k = fh.read(klen)
+                    vlen = int.from_bytes(fh.read(4), "little")
+                    v = fh.read(vlen)
+                    if len(k) != klen or len(v) != vlen:
+                        print(f"{args.dump}: truncated", file=sys.stderr)
+                        return 1
+                    batch.append((k, v))
+                    count += 1
+                    if len(batch) >= 2000:
+                        kv.write_batch(batch)
+                        batch = []
+                if batch:
+                    kv.write_batch(batch)
+        finally:
+            kv.close()
+        print(json.dumps({"imported": count, "engine": cfg.storage_engine}))
+        return 0
+
     if not os.path.exists(db_path):
         print(f"no database at {db_path}", file=sys.stderr)
         return 1
     # same engine switch as the node itself: maintenance verbs must open
     # the store the node actually wrote
-    kv = (LsmKV if cfg.storage_engine == "lsm" else SqliteKV)(db_path)
-    state = StateManager(kv)
-    if args.db_cmd == "shrink":
-        stats = DbShrink(state, kv).shrink(args.retain)
-        print(json.dumps(stats))
-    elif args.db_cmd == "rollback":
-        height = args.height
-        old = state.committed_height()
-        try:
-            state.rollback_to(height)
-        except KeyError as e:
-            print(str(e), file=sys.stderr)
-            return 1
-        print(
-            json.dumps({"rolledBackFrom": old, "height": height})
-        )
+    kv = make_kv(db_path)
+    try:
+        if args.db_cmd == "shrink":
+            state = StateManager(kv)
+            stats = DbShrink(state, kv).shrink(args.retain)
+            print(json.dumps(stats))
+        elif args.db_cmd == "rollback":
+            state = StateManager(kv)
+            height = args.height
+            old = state.committed_height()
+            try:
+                state.rollback_to(height)
+            except KeyError as e:
+                print(str(e), file=sys.stderr)
+                return 1
+            print(
+                json.dumps({"rolledBackFrom": old, "height": height})
+            )
+        elif args.db_cmd == "compact":
+            if not isinstance(kv, LsmKV):
+                print("compact: only the lsm engine", file=sys.stderr)
+                return 1
+            before = kv.table_count()
+            kv.compact()
+            print(json.dumps(
+                {"tablesBefore": before, "tablesAfter": kv.table_count(),
+                 "stats": kv.stats()}
+            ))
+        elif args.db_cmd == "export":
+            count = 0
+            with open(args.out, "wb") as fh:
+                fh.write(_DB_DUMP_MAGIC)
+                for k, v in kv.scan_prefix(b""):
+                    fh.write(len(k).to_bytes(4, "little") + k)
+                    fh.write(len(v).to_bytes(4, "little") + v)
+                    count += 1
+            print(json.dumps({"exported": count, "path": args.out}))
+    finally:
+        kv.close()
     return 0
 
 
@@ -786,6 +860,25 @@ def main(argv=None) -> int:
     rb.add_argument("--config", required=True)
     rb.add_argument("--height", type=int, required=True)
     rb.set_defaults(fn=cmd_db)
+    cp = dbsub.add_parser(
+        "compact", help="full LSM merge to a single table (lsm engine only)"
+    )
+    cp.add_argument("--config", required=True)
+    cp.set_defaults(fn=cmd_db)
+    ex = dbsub.add_parser(
+        "export", help="dump every key/value to an engine-portable file"
+    )
+    ex.add_argument("--config", required=True)
+    ex.add_argument("--out", required=True)
+    ex.set_defaults(fn=cmd_db)
+    im = dbsub.add_parser(
+        "import",
+        help="load an export into a FRESH store of the configured engine "
+             "(the sqlite<->lsm migration path)",
+    )
+    im.add_argument("--config", required=True)
+    im.add_argument("--dump", required=True)
+    im.set_defaults(fn=cmd_db)
 
     en = sub.add_parser("encrypt", help="password-protect a wallet file")
     en.add_argument("--wallet", required=True)

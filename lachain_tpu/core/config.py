@@ -14,7 +14,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Union
 
-CURRENT_VERSION = 6
+CURRENT_VERSION = 7
 
 # "not scheduled yet" sentinel for migrated hardfork heights: far above any
 # realistic chain height, so is_active() stays False until the operator
@@ -91,6 +91,18 @@ def _v5_to_v6(cfg: dict) -> dict:
     hf.setdefault("heights", {}).setdefault(
         "fast_wasm_gas", HARDFORK_HEIGHT_NEVER
     )
+    return cfg
+
+
+@_migration(6)
+def _v6_to_v7(cfg: dict) -> dict:
+    # v7 (round 6): the default storage engine flipped to the native LSM.
+    # A MIGRATED config belongs to a chain whose database was written by
+    # sqlite; the two on-disk formats are not interchangeable, so flipping
+    # it silently would abandon the existing chain and resync a fresh LSM
+    # store from genesis. Pin what the config was actually running. Fresh
+    # v7 configs (cli.py keygen) write engine: "lsm" explicitly.
+    cfg.setdefault("storage", {}).setdefault("engine", "sqlite")
     return cfg
 
 
@@ -215,10 +227,13 @@ class NodeConfig:
 
     @property
     def storage_engine(self) -> str:
-        """"sqlite" (default) or "lsm" (the native C++ LSM engine).
-        Unknown names are a hard error: silently falling back to sqlite
-        would rebuild a fresh chain from genesis on a typo."""
-        engine = self.raw.get("storage", {}).get("engine", "sqlite")
+        """"lsm" (the native C++ LSM engine, the default since v7) or
+        "sqlite" (explicit opt-out). Configs migrated from <=v6 carry
+        engine: "sqlite" pinned by the v6->v7 migration — their database
+        was written by sqlite and the formats are not interchangeable.
+        Unknown names are a hard error: silently falling back would
+        rebuild a fresh chain from genesis on a typo."""
+        engine = self.raw.get("storage", {}).get("engine", "lsm")
         if engine not in ("sqlite", "lsm"):
             raise ValueError(
                 f"unknown storage.engine {engine!r} (use 'sqlite' or 'lsm')"

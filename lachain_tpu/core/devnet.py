@@ -12,14 +12,14 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..consensus import messages as M
 from ..consensus.keys import trusted_key_gen
 from ..consensus.root_protocol import RootProtocol
 from ..consensus.simulator import DeliveryMode, SimulatedNetwork
 from ..crypto import ecdsa
-from ..storage.kv import MemoryKV
+from ..storage.kv import KVStore, MemoryKV
 from ..storage.state import StateManager
 from . import system_contracts
 from .block_manager import BlockManager
@@ -34,7 +34,7 @@ DEFAULT_CHAIN_ID = 225  # our own chain id
 @dataclass
 class DevnetNode:
     index: int
-    kv: MemoryKV
+    kv: KVStore
     state: StateManager
     block_manager: BlockManager
     pool: TransactionPool
@@ -56,6 +56,7 @@ class Devnet:
         engine: str = "python",
         fault_plan=None,
         max_recovery_rounds: int = 16,
+        kv_factory: Optional[Callable[[int], KVStore]] = None,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
@@ -68,9 +69,13 @@ class Devnet:
         self.public_keys, self.private_keys = trusted_key_gen(n, f, rng=_Rng())
         self.initial_balances = dict(initial_balances or {})
 
+        # kv_factory(node_index) -> KVStore lets campaigns run each
+        # validator on a DURABLE engine (LsmKV/SqliteKV store per node)
+        # instead of the default in-memory store — the state-root identity
+        # tests drive the same devnet over both engines this way
         self.nodes: List[DevnetNode] = []
         for i in range(n):
-            kv = MemoryKV()
+            kv = kv_factory(i) if kv_factory is not None else MemoryKV()
             state = StateManager(kv)
             # full system-contract registry (deploy/LRC-20/governance/staking)
             # so the devnet exercises the same execution surface as a real node
@@ -199,6 +204,12 @@ class Devnet:
         return out
 
     # -- helpers ------------------------------------------------------------------
+    def close(self) -> None:
+        """Release per-node stores (no-op for MemoryKV; required for the
+        durable engines a kv_factory may supply)."""
+        for node in self.nodes:
+            node.kv.close()
+
     def balance(self, addr: bytes, node: int = 0) -> int:
         return get_balance(self.nodes[node].state.new_snapshot(), addr)
 

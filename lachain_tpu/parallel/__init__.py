@@ -1,0 +1,40 @@
+"""Device-mesh parallelism package.
+
+:mod:`.mesh` does ``from jax import shard_map`` at import time, which only
+exists on newer jax builds (older ones keep it in ``jax.experimental``,
+with a different calling convention the module does not target), and its
+pipelines need more than one visible device. Probe with the helpers below
+before importing it — tests skip on the probe instead of erroring at
+collection, and single-device hosts fall back to the host/Pallas
+pipelines (crypto/tpu_backend.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map_available() -> bool:
+    """True when this jax build exports the top-level ``jax.shard_map``
+    that :mod:`.mesh` is written against."""
+    try:
+        from jax import shard_map  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def mesh_unsupported_reason() -> Optional[str]:
+    """None when the mesh pipeline can actually run here; otherwise a
+    human-readable skip reason (missing jax.shard_map export, or a
+    single-device host)."""
+    if not shard_map_available():
+        return "this jax build has no top-level jax.shard_map export"
+    import jax
+
+    if len(jax.devices()) < 2:
+        return "needs a multi-device platform"
+    return None
+
+
+def mesh_supported() -> bool:
+    return mesh_unsupported_reason() is None

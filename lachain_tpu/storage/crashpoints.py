@@ -37,6 +37,18 @@ Instrumented point names:
   shrink.mark.height                  per-height mark checkpoint
   shrink.sweep.pre / shrink.clean.pre stage transitions
   pool.save.mid                       between pool admission and persist
+  lsm.wal.encoded                     LsmKV only: the batch's WAL record
+                                      partially written (torn tail), never
+                                      fsynced/applied — replay discards it
+  lsm.wal.fsynced                     LsmKV only: record durable but never
+                                      acked/applied — replay applies it
+  lsm.compact.mid                     LsmKV only: merged SST renamed into
+                                      place, manifest swap lost — open()
+                                      sweeps the orphan
+
+The lsm.* sites leave REAL torn native state (lsm.py calls the engine's
+partial-execution debug APIs before dying), identical bytes on disk in
+both harness modes.
 """
 from __future__ import annotations
 

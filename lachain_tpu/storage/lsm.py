@@ -3,22 +3,38 @@
 Role of the reference's RocksDB context
 (/root/reference/src/Lachain.Storage/RocksDbContext.cs:23-60): a log-
 structured KV store with WAL-synced atomic batches. The engine itself is
-C++ (storage/native/lsm.cpp): CRC-framed fsynced WAL -> sorted memtable ->
-immutable sorted tables + manifest, full compaction. Durability contract
-matches SqliteKV's synchronous=FULL batches (same kill -9 guarantees,
-tests/test_lsm.py + test_storage_crash shape).
+C++ (storage/native/lsm.cpp, format v2): CRC-framed WAL segments written
+and fsynced by a pipeline thread (group commit; the batch ack fires only
+after the fsync) -> arena/skiplist memtable -> block-based SSTables with
+per-table bloom filters and a shared block cache, flushed and compacted by
+rate-limited background threads. Durability contract matches SqliteKV's
+synchronous=FULL batches (same kill -9 guarantees, tests/test_lsm.py +
+tests/test_crashpoints.py shape).
 
 Single-op put/delete are WAL-synced one-op batches — same semantics as
 SqliteKV's autocommit puts, with the fsync cost that implies; bulk paths
 use write_batch exactly as they do over SqliteKV.
+
+Crash-point sites (tests/test_crashpoints.py matrix): beyond the generic
+kv.write_batch.pre/.post, write_batch visits three engine-specific points
+that leave REAL torn state via the native partial-execution debug APIs
+before dying — lsm.wal.encoded (torn record tail in the active WAL
+segment), lsm.wal.fsynced (record durable but never acked/applied), and
+lsm.compact.mid (merged SST renamed into place but the manifest swap
+lost). Identical bytes on disk in both harness modes.
+
+Set LACHAIN_LSM_LIB to load an alternate engine build (the ASan/UBSan
+gate in tests/native/sanitize.sh runs the storage test slice against a
+sanitizer-instrumented libllsm).
 """
 from __future__ import annotations
 
 import ctypes
 import os
+import signal
 import subprocess
 import threading
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from .kv import KVStore
 
@@ -26,27 +42,54 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libllsm.so")
 _lib_cache: list = [None]
 
+# lsm_stats() slot order (keep in sync with Lsm::fill_stats)
+_STAT_FIELDS = (
+    "bloom_hits",       # filter ruled a table out (saved a block fetch)
+    "bloom_misses",     # filter passed; a data block was consulted
+    "cache_hits",
+    "cache_misses",
+    "wal_fsyncs",
+    "wal_records",
+    "compactions",
+    "table_count",
+    "memtable_bytes",
+    "imm_memtables",
+)
+
 
 def _load_lib():
     if _lib_cache[0] is not None:
         return _lib_cache[0]
-    sources = [
-        os.path.join(_NATIVE_DIR, "lsm.cpp"),
-        os.path.join(_NATIVE_DIR, "Makefile"),
-    ]
-    if not os.path.exists(_LIB_PATH) or any(
-        os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
-    ):
-        subprocess.run(
-            ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
-        )
-    lib = ctypes.CDLL(_LIB_PATH)
+    override = os.environ.get("LACHAIN_LSM_LIB")
+    lib_path = override or _LIB_PATH
+    if not override:
+        sources = [
+            os.path.join(_NATIVE_DIR, "lsm.cpp"),
+            os.path.join(_NATIVE_DIR, "Makefile"),
+        ]
+        if not os.path.exists(_LIB_PATH) or any(
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
+        ):
+            subprocess.run(
+                ["make", "-s", "-C", _NATIVE_DIR], check=True,
+                capture_output=True,
+            )
+    lib = ctypes.CDLL(lib_path)
     lib.lsm_open.restype = ctypes.c_void_p
     lib.lsm_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.lsm_open2.restype = ctypes.c_void_p
+    lib.lsm_open2.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
     lib.lsm_close.argtypes = [ctypes.c_void_p]
     lib.lsm_write_batch.restype = ctypes.c_int
     lib.lsm_write_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.lsm_write_batch_partial.restype = ctypes.c_int
+    lib.lsm_write_batch_partial.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
     ]
     lib.lsm_get.restype = ctypes.c_int
     lib.lsm_get.argtypes = [
@@ -62,11 +105,20 @@ def _load_lib():
     ]
     lib.lsm_flush.restype = ctypes.c_int
     lib.lsm_flush.argtypes = [ctypes.c_void_p]
+    lib.lsm_compact_now.restype = ctypes.c_int
+    lib.lsm_compact_now.argtypes = [ctypes.c_void_p]
+    lib.lsm_compact_partial.restype = ctypes.c_int
+    lib.lsm_compact_partial.argtypes = [ctypes.c_void_p]
+    lib.lsm_wait_compaction.restype = ctypes.c_int
+    lib.lsm_wait_compaction.argtypes = [ctypes.c_void_p]
+    lib.lsm_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
     lib.lsm_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
     lib.lsm_table_count.restype = ctypes.c_uint64
     lib.lsm_table_count.argtypes = [ctypes.c_void_p]
     lib.lsm_version.restype = ctypes.c_int
-    assert lib.lsm_version() == 1
+    assert lib.lsm_version() == 2
     _lib_cache[0] = lib
     return lib
 
@@ -90,10 +142,20 @@ def _encode_batch(
 class LsmKV(KVStore):
     """Durable KV on the native LSM engine (drop-in for SqliteKV)."""
 
-    def __init__(self, path: str, flush_threshold: int = 8 << 20):
+    def __init__(
+        self,
+        path: str,
+        flush_threshold: int = 8 << 20,
+        cache_bytes: int = 0,
+        compact_tables: int = 0,
+        compact_rate_mbps: int = 0,
+    ):
         self._lib = _load_lib()
         self._lock = threading.Lock()
-        self._h = self._lib.lsm_open(path.encode(), flush_threshold)
+        self._h = self._lib.lsm_open2(
+            path.encode(), flush_threshold, cache_bytes,
+            compact_tables, compact_rate_mbps,
+        )
         if not self._h:
             raise IOError(f"cannot open LSM store at {path!r}")
 
@@ -118,6 +180,43 @@ class LsmKV(KVStore):
     def delete(self, key: bytes) -> None:
         self.write_batch([], [key])
 
+    # engine-specific crash sites: leave genuinely torn native state via
+    # the partial-execution debug APIs, THEN die the way the armed point
+    # asks (InjectedCrash or real SIGKILL). The disk image is identical in
+    # both modes, which is what makes the matrix verdicts comparable.
+    _TORN_SITES = (("lsm.wal.encoded", 0), ("lsm.wal.fsynced", 1))
+
+    def _visit_torn_sites(self, payload: bytes) -> None:
+        from . import crashpoints
+
+        session = crashpoints.active()
+        if session is None:
+            return
+        for name, stage in self._TORN_SITES:
+            point = session.visit(name)
+            if point is not None:
+                with self._lock:
+                    rc = self._lib.lsm_write_batch_partial(
+                        self._h, payload, len(payload), stage
+                    )
+                if rc != 0:
+                    raise IOError(f"LSM partial write failed at {name}")
+                self._die(point, name)
+        point = session.visit("lsm.compact.mid")
+        if point is not None:
+            with self._lock:
+                if self._lib.lsm_compact_partial(self._h) != 0:
+                    raise IOError("LSM partial compaction failed")
+            self._die(point, "lsm.compact.mid")
+
+    @staticmethod
+    def _die(point, name: str) -> None:
+        from .crashpoints import MODE_SIGKILL, InjectedCrash
+
+        if point.mode == MODE_SIGKILL:
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedCrash(name, point.hit)
+
     def write_batch(
         self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()
     ) -> None:
@@ -125,11 +224,12 @@ class LsmKV(KVStore):
 
         crash_point("kv.write_batch.pre")
         payload = _encode_batch(list(puts), list(deletes))
+        self._visit_torn_sites(payload)
         with self._lock:
             if self._lib.lsm_write_batch(self._h, payload, len(payload)) != 0:
                 raise IOError("LSM write_batch failed")
         # no .mid point: the batch commits inside one native call — the
-        # torn-WAL-tail window is exercised by the engine's own crash test
+        # torn-WAL windows are the lsm.wal.* sites above
         crash_point("kv.write_batch.post")
 
     def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
@@ -161,13 +261,49 @@ class LsmKV(KVStore):
             yield (k, v)
 
     def flush(self) -> None:
-        """Force the memtable into a durable sorted table."""
+        """Seal the memtable and wait until it is a durable sorted table."""
         with self._lock:
             if self._lib.lsm_flush(self._h) != 0:
                 raise IOError("LSM flush failed")
 
+    def compact(self) -> None:
+        """Flush, then run one full merge to a single table (CLI/db verb)."""
+        with self._lock:
+            if self._lib.lsm_compact_now(self._h) != 0:
+                raise IOError("LSM compaction failed")
+
+    def wait_compaction(self) -> None:
+        """Block until no background compaction is scheduled or running."""
+        self._lib.lsm_wait_compaction(self._h)
+
     def table_count(self) -> int:
         return int(self._lib.lsm_table_count(self._h))
+
+    def stats(self) -> Dict[str, int]:
+        """Engine counters snapshot; publishes the read-path gauges
+        (lsm_bloom_hits/misses, lsm_cache_hit_ratio, ...) as a side
+        effect so an RPC metrics scrape after a commit sees them."""
+        arr = (ctypes.c_uint64 * len(_STAT_FIELDS))()
+        self._lib.lsm_stats(self._h, arr, len(_STAT_FIELDS))
+        out = dict(zip(_STAT_FIELDS, (int(v) for v in arr)))
+        self._publish_metrics(out)
+        return out
+
+    @staticmethod
+    def _publish_metrics(stats: Dict[str, int]) -> None:
+        from ..utils import metrics
+
+        metrics.set_gauge("lsm_bloom_hits", stats["bloom_hits"])
+        metrics.set_gauge("lsm_bloom_misses", stats["bloom_misses"])
+        lookups = stats["cache_hits"] + stats["cache_misses"]
+        metrics.set_gauge(
+            "lsm_cache_hit_ratio",
+            stats["cache_hits"] / lookups if lookups else 0.0,
+        )
+        metrics.set_gauge("lsm_table_count", stats["table_count"])
+        metrics.set_gauge("lsm_compactions_total", stats["compactions"])
+        metrics.set_gauge("lsm_wal_fsyncs_total", stats["wal_fsyncs"])
+        metrics.set_gauge("lsm_wal_records_total", stats["wal_records"])
 
     def close(self) -> None:
         with self._lock:

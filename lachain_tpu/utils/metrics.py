@@ -195,6 +195,19 @@ def counter_value(name: str, labels: Optional[dict] = None) -> float:
         return _counters.get((name, _label_key(labels)), 0.0)
 
 
+def gauge_value(name: str, labels: Optional[dict] = None) -> Optional[float]:
+    with _lock:
+        return _gauges.get((name, _label_key(labels)))
+
+
+# LSM read-path gauges published by storage/lsm.py (LsmKV.publish_metrics):
+#   lsm_bloom_hits       lookups a table's bloom filter ruled out (the block
+#                        fetch the filter saved)
+#   lsm_bloom_misses     lookups the filter passed through to a block read
+#   lsm_cache_hit_ratio  block-cache hits / (hits + misses), 0.0 when cold
+#   lsm_table_count      live SSTables, lsm_compactions_total merges done
+
+
 def observe(name: str, seconds: float) -> None:
     with _lock:
         cnt, total = _timers.get(name, (0, 0.0))

@@ -75,7 +75,7 @@ int main(int argc, char** argv) {
     int f = (n - 1) / 3;
     int mode = (int)(rnd() % 3);
     void* h = rt_new(n, f, mode, /*repeat_ppm=*/200000, rnd(), 1);
-    rt_set_callbacks(h, opaque_cb, acs_cb, coin_cb);
+    rt_set_callbacks(h, opaque_cb, acs_cb, coin_cb, nullptr);
     if (rnd() % 4 == 0) rt_mute(h, (int)(rnd() % n));
     for (int v = 0; v < n; v++) {
       uint8_t data[256];

@@ -4,6 +4,10 @@
 #   1. the MSM/pairing differential harness (benchmarks/native/check_msm)
 #   2. a time-boxed decoder fuzzer (structured + random mutations)
 #   3. a time-boxed consensus-engine fuzzer (hostile shards, live engines)
+#   4. a time-boxed LSM corruption fuzzer
+#   5. the Python storage test slice against a SANITIZED libllsm.so —
+#      the real multi-threaded engine (WAL pipeline, flusher, compactor)
+#      under ASan/UBSan, driven by the same tests CI runs
 # Any sanitizer report aborts with a non-zero exit (no recover).
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -18,6 +22,8 @@ g++ $CXXFLAGS -o "$BUILD/check_msm" ../../benchmarks/native/check_msm.cpp
 g++ $CXXFLAGS -o "$BUILD/fuzz_decoders" fuzz_decoders.cpp
 g++ $CXXFLAGS -o "$BUILD/fuzz_consensus" fuzz_consensus.cpp
 g++ $CXXFLAGS -o "$BUILD/fuzz_lsm" fuzz_lsm.cpp
+g++ $CXXFLAGS -fPIC -shared -o "$BUILD/libllsm_san.so" \
+    ../../lachain_tpu/storage/native/lsm.cpp
 
 echo "== differential (sanitized) =="
 "$BUILD/check_msm"
@@ -27,4 +33,19 @@ echo "== fuzz consensus (${FUZZ_SECONDS}s) =="
 "$BUILD/fuzz_consensus" "$FUZZ_SECONDS"
 echo "== fuzz lsm corruption (${FUZZ_SECONDS}s) =="
 "$BUILD/fuzz_lsm" "$FUZZ_SECONDS"
+
+echo "== storage slice over sanitized libllsm.so =="
+# python itself is not ASan-instrumented: the runtime must be preloaded,
+# and leak checking disabled (the interpreter's arenas never free).
+# LACHAIN_LSM_LIB makes lsm.py load the sanitized build verbatim (no
+# mtime-rebuild). Slow campaigns excluded: the gate stays time-boxed.
+ASAN_RT="$(gcc -print-file-name=libasan.so)"
+UBSAN_RT="$(gcc -print-file-name=libubsan.so)"
+SAN_LIB="$(cd "$BUILD" && pwd)/libllsm_san.so"
+(cd ../.. && \
+    LD_PRELOAD="$ASAN_RT $UBSAN_RT" \
+    ASAN_OPTIONS="detect_leaks=0,abort_on_error=1,verify_asan_link_order=0" \
+    LACHAIN_LSM_LIB="$SAN_LIB" \
+    JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_lsm.py -q -m "not slow" -p no:cacheprovider)
 echo "SANITIZE GREEN"

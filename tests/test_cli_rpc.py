@@ -259,6 +259,98 @@ def test_operator_verbs(tmp_path):
     assert StateManager(kv).committed_height() == 2
 
 
+def test_db_verbs_and_fsck_over_lsm_engine(tmp_path):
+    """Satellite: the db maintenance verbs and `fsck --deep` operate on
+    the LSM engine (they were built on sqlite assumptions), and
+    export/import is the supported sqlite<->lsm migration path."""
+    import io
+    import json as _json
+    import sys as _sys
+
+    from lachain_tpu.cli import main
+    from lachain_tpu.core.config import CURRENT_VERSION
+    from lachain_tpu.core.system_contracts import make_executer
+    from lachain_tpu.core.block_manager import BlockManager
+    from lachain_tpu.core.types import BlockHeader, MultiSig, tx_merkle_root
+    from lachain_tpu.storage.kv import SqliteKV
+    from lachain_tpu.storage.lsm import LsmKV
+    from lachain_tpu.storage.state import StateManager
+
+    db_path = str(tmp_path / "chain.lsm")
+    kv = LsmKV(db_path, flush_threshold=4096)
+    state = StateManager(kv)
+    bm = BlockManager(kv, state, make_executer(99))
+    bm.build_genesis({b"\x07" * 20: 10**18}, 99)
+    for height in (1, 2, 3):
+        em = bm.emulate([], height)
+        prev = bm.block_by_height(height - 1)
+        header = BlockHeader(
+            index=height, prev_block_hash=prev.hash(),
+            merkle_root=tx_merkle_root([]), state_hash=em.state_hash,
+            nonce=height,
+        )
+        bm.execute_block(header, [], MultiSig(()))
+    kv.close()
+
+    cfg_path = str(tmp_path / "lsm.json")
+    with open(cfg_path, "w") as f:
+        _json.dump(
+            {
+                "version": CURRENT_VERSION,
+                "storage": {"path": db_path, "engine": "lsm"},
+            },
+            f,
+        )
+
+    def run(argv):
+        buf = io.StringIO()
+        old = _sys.stdout
+        _sys.stdout = buf
+        try:
+            rc = main(argv)
+        finally:
+            _sys.stdout = old
+        return rc, buf.getvalue()
+
+    rc, out = run(["db", "rollback", "--config", cfg_path, "--height", "2"])
+    assert rc == 0 and _json.loads(out)["height"] == 2
+    rc, out = run(["db", "shrink", "--config", cfg_path, "--retain", "1"])
+    assert rc == 0 and "swept" in _json.loads(out)
+    rc, out = run(["db", "compact", "--config", cfg_path])
+    assert rc == 0
+    assert _json.loads(out)["tablesAfter"] == 1  # folds to a single table
+    rc, out = run(["fsck", "--config", cfg_path, "--deep"])
+    assert rc == 0 and _json.loads(out)["fatal"] is False
+    dump = str(tmp_path / "chain.dump")
+    rc, out = run(["db", "export", "--config", cfg_path, "--out", dump])
+    assert rc == 0 and _json.loads(out)["exported"] > 0
+
+    # import into a FRESH sqlite store: the cross-engine migration path
+    sq_db = str(tmp_path / "chain.sqlite")
+    sq_cfg = str(tmp_path / "sq.json")
+    with open(sq_cfg, "w") as f:
+        _json.dump(
+            {
+                "version": CURRENT_VERSION,
+                "storage": {"path": sq_db, "engine": "sqlite"},
+            },
+            f,
+        )
+    rc, out = run(["db", "import", "--config", sq_cfg, "--dump", dump])
+    assert rc == 0 and _json.loads(out)["imported"] > 0
+    # refuses to import over an existing store
+    rc, _ = run(["db", "import", "--config", sq_cfg, "--dump", dump])
+    assert rc == 1
+
+    src, dst = LsmKV(db_path), SqliteKV(sq_db)
+    try:
+        assert StateManager(dst).committed_height() == 2
+        assert dict(src.scan_prefix(b"")) == dict(dst.scan_prefix(b""))
+    finally:
+        src.close()
+        dst.close()
+
+
 @pytest.mark.slow
 def test_seed_only_discovery_and_restart_rejoin(tmp_path):
     """Deployment-slice acceptance (docker-compose.4nodes.yml flow):

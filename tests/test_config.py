@@ -63,7 +63,7 @@ def test_round4_migrations_v3_to_v6():
         "hardfork": {},
     }
     out = migrate(v3)
-    assert out["version"] == CURRENT_VERSION == 6
+    assert out["version"] == CURRENT_VERSION == 7
     assert out["network"]["advertiseHost"] is None
     # scaled to the config's own short cycle (50 // 5), never >= the cycle
     assert out["staking"]["attendanceDetectionDuration"] == 10
@@ -80,3 +80,20 @@ def test_round4_migrations_v3_to_v6():
         "hardfork": {"heights": {"fast_wasm_gas": 12345}},
     }
     assert migrate(v5)["hardfork"]["heights"]["fast_wasm_gas"] == 12345
+
+
+def test_v6_to_v7_storage_engine_migration():
+    """Round 6 flipped the default engine to LSM — but ONLY for fresh
+    configs. A migrated <=v6 config's database was written by sqlite and
+    the formats are not interchangeable, so the migration pins sqlite;
+    flipping it silently would abandon the chain and resync from genesis."""
+    out = migrate({"version": 6})
+    assert out["version"] == CURRENT_VERSION
+    assert out["storage"]["engine"] == "sqlite"
+    # the pin follows the whole chain from any pre-v7 version
+    assert migrate({"version": 1, "port": 1})["storage"]["engine"] == "sqlite"
+    # an operator's explicit choice is never clobbered
+    v6 = {"version": 6, "storage": {"engine": "lsm"}}
+    assert migrate(v6)["storage"]["engine"] == "lsm"
+    # fresh v7 configs default to the native engine
+    assert NodeConfig.from_dict({"version": 7}).storage_engine == "lsm"

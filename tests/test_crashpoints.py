@@ -24,7 +24,7 @@ from lachain_tpu.storage.fsck import FsckError, fsck
 from lachain_tpu.storage.kv import EntryPrefix, prefixed
 from lachain_tpu.utils.serialization import write_u64
 
-pytestmark = pytest.mark.crash
+pytestmark = [pytest.mark.crash, pytest.mark.storage]
 
 # (point spec, hit) -> the torn state fsck must see on reopen.
 # "clean" = the engine's atomicity absorbed the crash entirely;
@@ -89,6 +89,84 @@ def test_crash_matrix_fsck_verdicts(tmp_path, engine, name, hit, expect):
         assert not recheck.fatal, recheck.to_dict()
         assert {i.code for i in recheck.issues} <= {"shrink-resume"}
         # and the workload completes from wherever the crash left it
+        stats = run_workload(kv)
+        assert stats["height"] == 6
+    finally:
+        kv.close()
+
+
+# LSM pipeline/compaction points (lsm.py leaves real torn native state via
+# the engine's partial-execution APIs before dying). Hit 4 is block 1's
+# persist batch on LsmKV (same counting as kv.write_batch.* there):
+#   encoded  -> torn record tail, replay discards it  -> pre-commit crash
+#   fsynced  -> record durable, never acked/applied   -> the batch commits
+#               on replay but state.commit is lost    -> orphan-block
+#   compact.mid -> merged SST renamed, manifest swap lost -> orphan table
+#               swept at open, old set serves everything
+LSM_MATRIX = [
+    ("lsm.wal.encoded", 4, "clean"),
+    ("lsm.wal.fsynced", 4, "orphan-block"),
+    ("lsm.compact.mid", 3, "clean"),
+]
+
+
+@pytest.mark.parametrize("name,hit,expect", LSM_MATRIX)
+def test_lsm_pipeline_crash_matrix_injected(tmp_path, name, hit, expect):
+    """In-process mode: the lsm.* sites produce their torn state through
+    the native partial APIs, fsck classifies it, the workload resumes."""
+    db = str(tmp_path / "m.db")
+    _crashed_run(db, "lsm", name, hit)
+
+    kv = open_kv(db, "lsm")
+    try:
+        report = fsck(kv, repair=True)
+        assert not report.fatal, report.to_dict()
+        if expect == "clean":
+            assert report.clean, report.to_dict()
+        else:
+            assert expect in {i.code for i in report.issues}, report.to_dict()
+        recheck = fsck(kv, repair=False)
+        assert not recheck.fatal
+        assert {i.code for i in recheck.issues} <= {"shrink-resume"}
+        stats = run_workload(kv)
+        assert stats["height"] == 6
+    finally:
+        kv.close()
+
+
+@pytest.mark.parametrize("name,hit,expect", LSM_MATRIX)
+def test_lsm_pipeline_crash_matrix_sigkill(tmp_path, name, hit, expect):
+    """Real-death mode: same matrix, actual SIGKILL — the torn bytes on
+    disk must be identical to the in-process mode, so the verdicts are."""
+    db = str(tmp_path / "kill.db")
+    env = dict(os.environ)
+    env[crashpoints.ENV_VAR] = CrashPlan(
+        points=(CrashPoint(name, hit, "sigkill"),)
+    ).encode_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    child = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "lachain_tpu.storage.crash_workload",
+            db,
+            "lsm",
+        ],
+        env=env,
+        capture_output=True,
+        timeout=120,
+    )
+    assert child.returncode == -signal.SIGKILL, child.stderr.decode()
+
+    kv = open_kv(db, "lsm")
+    try:
+        report = fsck(kv, repair=True)
+        assert not report.fatal, report.to_dict()
+        if expect == "clean":
+            assert report.clean, report.to_dict()
+        else:
+            assert expect in {i.code for i in report.issues}, report.to_dict()
         stats = run_workload(kv)
         assert stats["height"] == 6
     finally:

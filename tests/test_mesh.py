@@ -13,6 +13,15 @@ import pytest
 
 import jax
 
+from lachain_tpu.parallel import mesh_unsupported_reason
+
+# The guard must run BEFORE the mesh import: on jax builds without the
+# top-level shard_map export the import itself raises, which a pytestmark
+# skipif cannot intercept (it fires after collection imports the module).
+_reason = mesh_unsupported_reason()
+if _reason is not None:
+    pytest.skip(_reason, allow_module_level=True)
+
 from lachain_tpu.crypto import bls12381 as bls
 from lachain_tpu.crypto import tpke
 from lachain_tpu.parallel.mesh import (
@@ -20,10 +29,6 @@ from lachain_tpu.parallel.mesh import (
     make_era_mesh,
     pad_pow2,
     sharded_glv_era_step,
-)
-
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 2, reason="needs the virtual multi-device platform"
 )
 
 

@@ -13,6 +13,9 @@ from lachain_tpu.storage.kv import MemoryKV, SqliteKV
 from lachain_tpu.storage.state import StateManager, StateRoots
 from lachain_tpu.storage.trie import EMPTY_ROOT, Trie
 
+# slice marker: durable-store engine tests ("make test-storage")
+pytestmark = pytest.mark.storage
+
 
 @pytest.mark.parametrize("backend", ["memory", "sqlite"])
 def test_kv_roundtrip(backend, tmp_path):
