@@ -7,7 +7,8 @@
 PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
-.PHONY: test test-kernel test-fast test-chaos test-storage native bench
+.PHONY: test test-kernel test-fast test-chaos test-storage \
+	test-observability native bench bench-gate
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -32,6 +33,11 @@ test-chaos:
 test-storage:
 	$(PYTEST) $(PYTEST_ARGS) -m storage
 
+# flight recorder + metrics: span tracer, native trace rings + merge
+# layer, era phase reports, Prometheus surface, compare.py gate
+test-observability:
+	$(PYTEST) $(PYTEST_ARGS) -m observability
+
 test:
 	$(PYTEST) $(PYTEST_ARGS)
 
@@ -44,3 +50,9 @@ native:
 bench:
 	python bench.py
 	python benchmarks/bench_consensus_sim.py --n 64 --eras 2
+
+# perf-regression gate: re-run the headline bench and diff it against the
+# checked-in baseline with noise-derived thresholds (exit 1 = regression)
+bench-gate:
+	python bench.py | tail -n 1 > /tmp/lachain_bench_now.json
+	python benchmarks/compare.py BENCH_r05.json /tmp/lachain_bench_now.json

@@ -172,6 +172,9 @@ def main() -> None:
         "tpu_era_s": round(tpu_s, 4),
         "tpu_device_s": round(phases["device"], 4),
         "tpu_host_s": round(tpu_s - phases["device"], 4),
+        # best-trial phase breakdown (always present — compare.py and the
+        # era report readers want the split without waiting for a noisy run)
+        "phases_s": {k: round(v, 4) for k, v in phases.items()},
         "baseline_era_s": round(baseline_s, 3),
         "baseline_per_share_ms": round(per_share_s * 1000, 3),
         "backend": jax.devices()[0].platform,

@@ -45,6 +45,13 @@ def main() -> None:
         choices=["native", "python"],
         help="consensus runtime: native C++ engine or the Python simulator",
     )
+    ap.add_argument(
+        "--overhead-check",
+        action="store_true",
+        help="after the timed eras, re-run the same era count with the "
+        "native trace rings disabled and report trace_overhead_pct "
+        "(acceptance: flight recorder costs <=2%% of era wall time)",
+    )
     args = ap.parse_args()
     if args.max_messages is None:
         # an era floods O(N^2) per RBC/BA round; 20M covers N<=64 with
@@ -55,7 +62,7 @@ def main() -> None:
     from lachain_tpu.core.devnet import Devnet
     from lachain_tpu.core.types import Transaction, sign_transaction
     from lachain_tpu.crypto import ecdsa
-    from lachain_tpu.utils import metrics
+    from lachain_tpu.utils import metrics, tracing
 
     n = args.n
     f = (n - 1) // 3
@@ -84,12 +91,13 @@ def main() -> None:
     times = []
     exec_times = []  # per-era total block-execution seconds across ALL nodes
     nonces = [0] * len(users)
-    for era in range(1, args.eras + 1):
+
+    def run_one_era(era: int) -> int:
         for k in range(args.txs):
             u = k % len(users)
             stx = sign_transaction(
                 Transaction(
-                    to=bytes([era]) * 20,
+                    to=bytes([era % 256]) * 20,
                     value=1,
                     nonce=nonces[u],
                     gas_price=1 + (k % 7),
@@ -105,7 +113,37 @@ def main() -> None:
         blocks = net.run_era(era, max_messages=args.max_messages)
         times.append(time.perf_counter() - t0)
         exec_times.append(_exec_total_s() - e0)
-        total_txs += len(blocks[0].tx_hashes)
+        return len(blocks[0].tx_hashes)
+
+    for era in range(1, args.eras + 1):
+        total_txs += run_one_era(era)
+
+    # flight-recorder era phase attribution for the timed eras (merged
+    # Python spans + native engine rings; see tracing.era_report)
+    phase_report = {
+        ent["era"]: {
+            "wall_s": ent["wall_s"],
+            **ent["phases_s"],
+            "idle_s": ent["idle_s"],
+        }
+        for ent in tracing.era_report()["eras"]
+        if 1 <= ent["era"] <= args.eras
+    }
+
+    trace_overhead_pct = None
+    if args.overhead_check:
+        # same warmed devnet, same era count, rings disabled: the ON/OFF
+        # min-era delta is the recorder's hot-path cost
+        times_on = list(times)
+        times.clear()
+        if hasattr(net.net, "trace_configure"):
+            net.net.trace_configure(0)
+        for era in range(args.eras + 1, 2 * args.eras + 1):
+            run_one_era(era)
+        times_off = list(times)
+        times = times_on  # headline numbers stay the recorded (ON) eras
+        off = min(times_off)
+        trace_overhead_pct = round(100.0 * (min(times_on) - off) / off, 2)
 
     # per-node normalization (VERDICT #8): the in-process sim makes ALL N
     # validators emulate+execute every block, but a real node executes it
@@ -138,6 +176,11 @@ def main() -> None:
                 " * (N-1)/N; block_execute timed via utils.metrics"
                 " 'block_execute' (every node executes every block in-sim,"
                 " a real node executes once)",
+                # flight recorder: where inside each timed era the time went
+                "era_phase_report_s": phase_report,
+                # ON-vs-OFF min-era delta when --overhead-check ran
+                # (acceptance: <= 2%)
+                "trace_overhead_pct": trace_overhead_pct,
             }
         )
     )

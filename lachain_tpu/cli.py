@@ -168,6 +168,14 @@ def _build_node(cfg, config_path=None):
     )
     if cfg.hardfork.heights:
         set_hardfork_heights(cfg.hardfork.heights, force=True)
+    if cfg.trace_capacity is not None:
+        # resize the merged rings now; native engines created after this
+        # point (LSM store below, consensus engine per era) size their
+        # in-engine rings from the same knob via tracing.DEFAULT_CAPACITY
+        from .utils import tracing
+
+        tracing.DEFAULT_CAPACITY = max(int(cfg.trace_capacity), 0)
+        tracing.set_capacity(max(tracing.DEFAULT_CAPACITY, 1))
     password = cfg.vault.password or os.environ.get(
         "LACHAIN_WALLET_PASSWORD", ""
     )
@@ -428,8 +436,17 @@ def cmd_trace(args) -> int:
     Chrome trace_event JSON — load it in chrome://tracing or Perfetto."""
     import urllib.request
 
-    method = "la_getTraceSummary" if args.summary else "la_getTrace"
-    params = [] if args.summary or args.limit is None else [args.limit]
+    if args.era_report:
+        method = "la_getEraReport"
+    elif args.summary:
+        method = "la_getTraceSummary"
+    else:
+        method = "la_getTrace"
+    params = (
+        []
+        if args.summary or args.era_report or args.limit is None
+        else [args.limit]
+    )
     body = json.dumps(
         {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
     ).encode()
@@ -443,6 +460,16 @@ def cmd_trace(args) -> int:
               file=sys.stderr)
         return 1
     result = out["result"]
+    if args.era_report:
+        from .utils import tracing
+
+        print(tracing.era_report_table(result))
+        reported = result.get("eras", [])
+        if reported and args.out:
+            with open(args.out, "w") as fh:
+                fh.write(json.dumps(result, indent=2))
+            print(f"era report -> {args.out}")
+        return 0
     if args.summary:
         print(json.dumps(result, indent=2, sort_keys=True))
         return 0
@@ -911,6 +938,12 @@ def main(argv=None) -> int:
         "--summary",
         action="store_true",
         help="print the per-span aggregate instead of the full trace",
+    )
+    tr.add_argument(
+        "--era-report",
+        action="store_true",
+        help="print the per-era phase table (propose/RBC/BA/coin/TPKE/"
+        "commit + idle) from the merged flight recorder",
     )
     tr.set_defaults(fn=cmd_trace)
 

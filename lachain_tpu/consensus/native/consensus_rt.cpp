@@ -21,6 +21,8 @@
 // including adversarial reorderings) is the property the reference's
 // thread-based harness only approximates.
 #include <algorithm>
+#include <array>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -649,6 +651,101 @@ struct Validator {
   void clear_protocols();  // defined after Engine (touches hb_queued_count)
 };
 
+// ---------------------------------------------------------------------------
+// Flight-recorder trace ring (shared record layout with storage/native/lsm.cpp
+// and utils/tracing.py: 32-byte big-endian records, see trace_put_event).
+// Timestamps are raw CLOCK_MONOTONIC (steady_clock) nanoseconds; the Python
+// binding measures the offset to time.monotonic() once per engine via
+// rt_monotonic_ns (clock handshake) so merged traces share one epoch.
+// Recording must never perturb protocol logic — events are written only to
+// this side ring, and a full ring overwrites the oldest record (dropped++).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t trace_now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct TraceEvent {
+  uint64_t ts_ns;   // steady_clock ns at event start
+  uint64_t dur_ns;  // 0 for instants
+  uint32_t kind;    // TK_* below
+  uint32_t tid;     // validator id (lane in the merged trace)
+  uint32_t a, b;    // kind-specific args (b is usually the era)
+};
+
+enum TraceKind : uint32_t {
+  TK_ERA_ADVANCE = 1,  // a = new era
+  TK_CROSS = 2,        // a = XO_* op, dur = time inside the Python callback
+  TK_POST = 3,         // a = PO_* op (coarse ops only; per-slot ops skipped)
+  TK_STAGE = 4,        // a = TS_* stage code
+  TK_PHASE = 5,        // a = TP_* phase, dur = accumulated dispatch ns
+};
+
+enum TraceStage : uint32_t {
+  TS_ACS_RESULT = 1,  // CommonSubset delivered its slot set
+};
+
+// Dispatch-phase buckets: per-message deliver() time (minus any time spent
+// inside Python crossings) accumulated by protocol family, flushed as one
+// TK_PHASE record per (era, phase). This is what gives the era report its
+// rbc/ba split on native runs, where no per-protocol Python spans exist.
+enum TracePhase : uint32_t {
+  TP_RBC = 1,     // VAL/ECHO/READY (RS decode + Merkle checks live here)
+  TP_BA = 2,      // BVAL/AUX/CONF + BA bookkeeping
+  TP_COIN = 3,    // coin-share opaque dispatch
+  TP_TPKE = 4,    // decrypt-share opaque dispatch
+  TP_COMMIT = 5,  // signed-header opaque dispatch
+  TP_OTHER = 6,
+};
+
+struct TraceRing {
+  std::vector<TraceEvent> buf;
+  size_t cap = 16384;  // LACHAIN_TRACE_CAPACITY overrides via *_configure
+  size_t w = 0;        // next write slot
+  size_t count = 0;    // live records (<= cap)
+  uint64_t dropped = 0;
+  bool enabled = true;
+
+  void configure(size_t capacity) {
+    buf.clear();
+    w = count = 0;
+    cap = capacity;
+    enabled = capacity > 0;
+  }
+  inline void push(uint64_t ts, uint64_t dur, uint32_t kind, uint32_t tid,
+                   uint32_t a, uint32_t b) {
+    if (!enabled) return;
+    if (buf.size() != cap) buf.resize(cap);  // lazy, first push only
+    buf[w] = {ts, dur, kind, tid, a, b};
+    w = (w + 1) % cap;
+    if (count < cap)
+      count++;
+    else
+      dropped++;  // overwrote the oldest unread record
+  }
+};
+
+static inline void trace_put32(std::string& out, uint32_t v) {
+  char b[4] = {(char)(v >> 24), (char)(v >> 16), (char)(v >> 8), (char)v};
+  out.append(b, 4);
+}
+
+static inline void trace_put64(std::string& out, uint64_t v) {
+  trace_put32(out, (uint32_t)(v >> 32));
+  trace_put32(out, (uint32_t)v);
+}
+
+static inline void trace_put_event(std::string& out, const TraceEvent& e) {
+  trace_put64(out, e.ts_ns);
+  trace_put64(out, e.dur_ns);
+  trace_put32(out, e.kind);
+  trace_put32(out, e.tid);
+  trace_put32(out, e.a);
+  trace_put32(out, e.b);
+}
+
 struct Engine {
   int n, f;
   int mode;               // 0 FIFO, 1 LIFO, 2 RANDOM
@@ -668,6 +765,58 @@ struct Engine {
   acs_cb_t cb_acs = nullptr;
   coinreq_cb_t cb_coinreq = nullptr;
   cross_cb_t cb_cross = nullptr;
+
+  // -- flight recorder ------------------------------------------------------
+  TraceRing trace;
+  // per-era exclusive dispatch time by protocol family (TP_*); std::map so
+  // flush order is deterministic across identically-seeded runs
+  std::map<uint32_t, std::array<uint64_t, 8>> phase_acc;
+  uint64_t cross_ns = 0;  // crossing time inside the current deliver()
+
+  static inline uint32_t phase_of(const Msg* m) {
+    switch (m->type) {
+      case MT_VAL:
+      case MT_ECHO:
+      case MT_READY:
+        return TP_RBC;
+      case MT_BVAL:
+      case MT_AUX:
+      case MT_CONF:
+        return TP_BA;
+      case MT_OPAQUE:
+        switch (m->opq_kind) {
+          case K_COIN:
+            return TP_COIN;
+          case K_DECRYPTED:
+            return TP_TPKE;
+          case K_SIGNED_HEADER:
+            return TP_COMMIT;
+        }
+        return TP_OTHER;
+    }
+    return TP_OTHER;
+  }
+
+  // flush finished-era dispatch accumulators into the ring (an era is
+  // finished once every validator has advanced past it: stale-era messages
+  // are dropped on delivery, so its accumulators can no longer grow)
+  void trace_flush_phases() {
+    if (!trace.enabled || phase_acc.empty()) return;
+    int min_era = vals[0].era;
+    for (auto& v : vals) min_era = v.era < min_era ? v.era : min_era;
+    uint64_t now = trace_now_ns();
+    for (auto it = phase_acc.begin(); it != phase_acc.end();) {
+      if ((int)it->first >= min_era) {
+        ++it;
+        continue;
+      }
+      for (uint32_t ph = 1; ph < 8; ph++)
+        if (it->second[ph])
+          trace.push(now, it->second[ph], TK_PHASE, 0xFFFFFFFFu, ph,
+                     it->first);
+      it = phase_acc.erase(it);
+    }
+  }
 
   Engine(int n_, int f_, int mode_, uint32_t ppm, uint64_t seed, int era0)
       : n(n_), f(f_), mode(mode_), repeat_ppm(ppm) {
@@ -865,7 +1014,21 @@ struct Engine {
       Entry e = pop();
       delivered++;
       processed++;
-      if (!muted.test(e.target)) deliver(e);
+      if (!muted.test(e.target)) {
+        if (trace.enabled) {
+          // exclusive dispatch time: crossings triggered by this message
+          // are timed separately (TK_CROSS) and subtracted here
+          uint32_t ph = phase_of(e.m);
+          uint32_t era = (uint32_t)e.m->era;
+          uint64_t t0 = trace_now_ns();
+          cross_ns = 0;
+          deliver(e);
+          uint64_t dt = trace_now_ns() - t0;
+          if (dt > cross_ns) phase_acc[era][ph] += dt - cross_ns;
+        } else {
+          deliver(e);
+        }
+      }
       msg_release(e.m);
     }
     stop_req = false;
@@ -875,8 +1038,11 @@ struct Engine {
   void advance_era(int vid, int new_era) {
     Validator& V = vals[vid];
     if (new_era <= V.era) return;  // eras never regress (era.py:122-132)
+    trace.push(trace_now_ns(), 0, TK_ERA_ADVANCE, (uint32_t)vid,
+               (uint32_t)new_era, (uint32_t)V.era);
     V.era = new_era;
     V.clear_protocols();
+    trace_flush_phases();
     std::vector<Entry> pending;
     pending.swap(V.postponed);
     V.postponed_per_sender.clear();
@@ -1320,9 +1486,21 @@ void Validator::clear_protocols() {
 }
 
 void Engine::cross(int vid, int op, int a, int b, const std::string& blob) {
-  if (cb_cross)
+  if (!cb_cross) return;
+  if (!trace.enabled) {
     cb_cross(vid, vals[vid].era, op, a, b,
              reinterpret_cast<const uint8_t*>(blob.data()), blob.size());
+    return;
+  }
+  uint64_t t0 = trace_now_ns();
+  cb_cross(vid, vals[vid].era, op, a, b,
+           reinterpret_cast<const uint8_t*>(blob.data()), blob.size());
+  uint64_t dt = trace_now_ns() - t0;
+  // nested crossings (a callback posting back can trigger another cross)
+  // over-accumulate here; run() guards with dt > cross_ns before subtracting
+  cross_ns += dt;
+  trace.push(t0, dt, TK_CROSS, (uint32_t)vid, (uint32_t)op,
+             (uint32_t)vals[vid].era);
 }
 
 NCoin* Engine::get_ncoin(Validator& V, int agreement, int epoch, bool create) {
@@ -1376,6 +1554,8 @@ void Engine::deliver_acs_result(int vid, ACS* a) {
     if (kv.second) slots.push_back(kv.first);
   std::sort(slots.begin(), slots.end());
   Validator& V = vals[vid];
+  trace.push(trace_now_ns(), 0, TK_STAGE, (uint32_t)vid, TS_ACS_RESULT,
+             (uint32_t)V.era);
   if (V.acs_to_hb && (V.own_mask & OWN_HB)) {
     NHB* hb = get_nhb(V, true);
     hb->on_acs(slots, a->rbc_results);
@@ -1450,6 +1630,13 @@ void Engine::native_request(int vid, int kind, int a, int b) {
 void Engine::native_post(int vid, int op, int a, int b, const uint8_t* data,
                          size_t len) {
   Validator& V = vals[vid];
+  // record the coarse once-per-stage posts only — the per-slot/per-sender
+  // ops (decrypted shares, accept/reject votes) would flood the ring
+  if (trace.enabled &&
+      (op == PO_COIN_RESULT || op == PO_HB_ACS_INPUT ||
+       op == PO_HB_ACS_DONE || op == PO_ROOT_HEADER))
+    trace.push(trace_now_ns(), 0, TK_POST, (uint32_t)vid, (uint32_t)op,
+               (uint32_t)V.era);
   std::string blob(reinterpret_cast<const char*>(data), len);
   switch (op) {
     case PO_COIN_SHARE: {
@@ -1862,7 +2049,7 @@ void NRoot::maybe_verify() {
 
 extern "C" {
 
-int lt_crt_version() { return 2; }
+int lt_crt_version() { return 3; }
 
 void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
              int era0) {
@@ -2005,6 +2192,47 @@ uint64_t rt_opaque_pending(void* h, int kind) {
 size_t rt_queue_len(void* h) { return static_cast<Engine*>(h)->q.size(); }
 
 uint64_t rt_delivered(void* h) { return static_cast<Engine*>(h)->delivered; }
+
+// -- flight recorder --------------------------------------------------------
+
+// Raw CLOCK_MONOTONIC now, for the Python clock-offset handshake: the binding
+// samples time.monotonic() around this call and keeps the tightest bracket.
+uint64_t rt_monotonic_ns() { return trace_now_ns(); }
+
+// capacity 0 disables recording entirely (no clock reads on the hot path)
+void rt_trace_configure(void* h, size_t capacity) {
+  static_cast<Engine*>(h)->trace.configure(capacity);
+}
+
+uint64_t rt_trace_dropped(void* h) {
+  return static_cast<Engine*>(h)->trace.dropped;
+}
+
+// Two-call drain (pattern of rt_debug_state): size query with buf == NULL,
+// then the copying call, which CONSUMES the ring. Output is 32-byte
+// big-endian records (u64 ts_ns, u64 dur_ns, u32 kind, u32 tid, u32 a,
+// u32 b); the tail carries a snapshot of the still-accumulating per-era
+// dispatch-phase totals (TK_PHASE, cumulative — the merge layer keeps the
+// latest record per (era, phase)).
+size_t rt_trace_drain(void* h, uint8_t* buf, size_t cap) {
+  Engine* E = static_cast<Engine*>(h);
+  TraceRing& r = E->trace;
+  std::string out;
+  out.reserve((r.count + 8 * E->phase_acc.size()) * 32);
+  size_t start = (r.w + r.cap - r.count) % (r.cap ? r.cap : 1);
+  for (size_t i = 0; i < r.count; i++)
+    trace_put_event(out, r.buf[(start + i) % r.cap]);
+  uint64_t now = trace_now_ns();
+  for (auto& kv : E->phase_acc)
+    for (uint32_t ph = 1; ph < 8; ph++)
+      if (kv.second[ph])
+        trace_put_event(out, {now, kv.second[ph], TK_PHASE, 0xFFFFFFFFu, ph,
+                              kv.first});
+  if (!buf || out.size() > cap) return out.size();
+  std::memcpy(buf, out.data(), out.size());
+  r.count = 0;  // consumed (w stays: the ring keeps filling from there)
+  return out.size();
+}
 
 // test/fuzz hook: drive rs_decode with arbitrary shard vectors (lens[i]==0
 // marks a missing shard). Returns 1 + writes out/out_len on success, 0 on

@@ -24,11 +24,14 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
+import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Set
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from . import messages as M
 from .era import EraRouter
 from .keys import PrivateConsensusKeys, PublicConsensusKeys
@@ -122,7 +125,7 @@ def load_rt():
         )
     lib = ctypes.CDLL(_LIB_PATH)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 2
+    assert lib.lt_crt_version() == 3
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -207,8 +210,116 @@ def load_rt():
     lib.rt_queue_len.argtypes = [ctypes.c_void_p]
     lib.rt_delivered.restype = ctypes.c_uint64
     lib.rt_delivered.argtypes = [ctypes.c_void_p]
+    lib.rt_monotonic_ns.restype = ctypes.c_uint64
+    lib.rt_monotonic_ns.argtypes = []
+    lib.rt_trace_configure.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.rt_trace_dropped.restype = ctypes.c_uint64
+    lib.rt_trace_dropped.argtypes = [ctypes.c_void_p]
+    lib.rt_trace_drain.restype = ctypes.c_size_t
+    lib.rt_trace_drain.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_size_t,
+    ]
     _lib_cache[0] = lib
     return lib
+
+
+# -- flight recorder ---------------------------------------------------------
+
+# consensus_rt.cpp trace record contract: 32-byte big-endian records
+_TRACE_RECORD = struct.Struct(">QQIIII")
+TK_ERA_ADVANCE, TK_CROSS, TK_POST, TK_STAGE, TK_PHASE = 1, 2, 3, 4, 5
+# TP_* dispatch-phase buckets -> era-report phase keys (tracing._DISPATCH_PHASE)
+TP_NAMES = {1: "rbc", 2: "ba", 3: "coin", 4: "tpke", 5: "commit", 6: "other"}
+# the coarse PO_* ops the engine records (native_post keeps per-slot ops out)
+_PO_TRACE_NAMES = {2: "coin_result", 3: "hb_acs_input", 5: "hb_acs_done",
+                   12: "root_header"}
+_TS_NAMES = {1: "acs_result"}
+TRACE_PID_CONSENSUS = 2  # Chrome-export process lane (python host is pid 1)
+
+
+# clock-offset handshake shared with the LSM binding
+clock_offset = tracing.clock_offset
+
+
+def decode_consensus_trace(
+    raw: bytes, offset: float, source: str = "consensus"
+) -> List[dict]:
+    """Raw drain buffer -> merged-tracer event dicts (see
+    tracing.register_native_source for the schema)."""
+    evs: List[dict] = []
+    for i in range(0, len(raw) - (len(raw) % 32), 32):
+        ts, dur, kind, tid, a, b = _TRACE_RECORD.unpack_from(raw, i)
+        start = ts / 1e9 + offset
+        end = (ts + dur) / 1e9 + offset
+        common = dict(
+            start=start,
+            end=end,
+            pid=TRACE_PID_CONSENSUS,
+            pname="native-consensus",
+        )
+        if kind == TK_CROSS:
+            op = XO_NAMES.get(a, str(a))
+            evs.append(
+                dict(
+                    common,
+                    name=f"cross:{op}",
+                    cat="native.cross",
+                    tid=tid,
+                    tname=f"validator-{tid}",
+                    args={"op": op, "era": b, "vid": tid},
+                )
+            )
+        elif kind == TK_PHASE:
+            phase = TP_NAMES.get(a, str(a))
+            evs.append(
+                dict(
+                    common,
+                    name=f"dispatch:{phase}",
+                    cat="native.phase",
+                    tid=0,
+                    tname="dispatch",
+                    # cumulative per-(era,phase) totals: latest wins
+                    replace_key=(source, b, a),
+                    args={"phase": phase, "era": b, "dur_ns": dur},
+                )
+            )
+        elif kind == TK_ERA_ADVANCE:
+            evs.append(
+                dict(
+                    common,
+                    name="era_advance",
+                    cat="native.consensus",
+                    tid=tid,
+                    tname=f"validator-{tid}",
+                    args={"vid": tid, "new_era": a, "old_era": b},
+                )
+            )
+        elif kind == TK_POST:
+            op = _PO_TRACE_NAMES.get(a, str(a))
+            evs.append(
+                dict(
+                    common,
+                    name=f"post:{op}",
+                    cat="native.consensus",
+                    tid=tid,
+                    tname=f"validator-{tid}",
+                    args={"op": op, "era": b, "vid": tid},
+                )
+            )
+        elif kind == TK_STAGE:
+            evs.append(
+                dict(
+                    common,
+                    name=f"stage:{_TS_NAMES.get(a, str(a))}",
+                    cat="native.consensus",
+                    tid=tid,
+                    tname=f"validator-{tid}",
+                    args={"stage": a, "era": b, "vid": tid},
+                )
+            )
+    return evs
 
 
 @dataclass(frozen=True)
@@ -692,9 +803,74 @@ class NativeSimulatedNetwork:
                 r.crypto_batcher = self.crypto_batcher
         self._own_masks = [-1] * self.n  # engine-side mask cache (-1 unset)
         self._sync_ownership()
+        # flight recorder: size the engine ring, align its clock with
+        # time.monotonic, and register it with the merged tracer. A weakref
+        # keeps the registry from pinning a leaked network alive; close()
+        # unregisters explicitly.
+        self._trace_offset = clock_offset(self._lib.rt_monotonic_ns)
+        self._trace_dropped_seen = 0
+        self._trace_source = f"consensus-{id(self):x}"
+        self.trace_configure(tracing.DEFAULT_CAPACITY)
+        ref = weakref.ref(self)
+        tracing.register_native_source(
+            self._trace_source,
+            lambda: (
+                [] if ref() is None else ref()._drain_trace()  # noqa: B023
+            ),
+        )
+
+    # -- flight recorder -------------------------------------------------------
+    def trace_configure(self, capacity: int) -> None:
+        """Resize the engine-side trace ring; 0 disables recording (and
+        the hot-path clock reads) entirely — the bench overhead check."""
+        if self._h is not None:
+            self._lib.rt_trace_configure(self._h, max(int(capacity), 0))
+
+    def trace_dropped(self) -> int:
+        if self._h is None:
+            return self._trace_dropped_seen
+        return int(self._lib.rt_trace_dropped(self._h))
+
+    def _drain_trace(self) -> List[dict]:
+        """Consume the engine ring -> merged-tracer event dicts. Publishes
+        native drop-counter growth as a counter delta so
+        trace_events_dropped_total keeps counter semantics."""
+        if self._h is None:
+            return []
+        evs: List[dict] = []
+        # size query, then copying call; the copy consumes the ring. Slack
+        # covers records appended between the two calls; if the ring still
+        # outgrew the buffer (got > len(buf) means no copy happened), retry.
+        for _ in range(4):
+            need = self._lib.rt_trace_drain(self._h, None, 0)
+            if need == 0:
+                break
+            buf = (ctypes.c_uint8 * (need + 4096))()
+            got = self._lib.rt_trace_drain(self._h, buf, len(buf))
+            if got <= len(buf):
+                evs = decode_consensus_trace(
+                    bytes(buf[:got]), self._trace_offset, self._trace_source
+                )
+                break
+        dropped = self.trace_dropped()
+        if dropped > self._trace_dropped_seen:
+            metrics.inc(
+                "trace_events_dropped_total",
+                dropped - self._trace_dropped_seen,
+                labels={"source": "consensus"},
+            )
+            self._trace_dropped_seen = dropped
+        return evs
 
     def close(self) -> None:
         if self._h is not None:
+            # pull any still-buffered engine events into the merged tracer
+            # before the ring is freed
+            try:
+                tracing.drain_native()
+            except Exception:
+                pass
+            tracing.unregister_native_source(self._trace_source)
             self._lib.rt_free(self._h)
             self._h = None
 

@@ -240,6 +240,15 @@ class NodeConfig:
             )
         return engine
 
+    @property
+    def trace_capacity(self) -> Optional[int]:
+        """Flight-recorder ring capacity (events) for BOTH the Python span
+        ring and the native engine rings. Optional and additive (no config
+        version bump): absent means the LACHAIN_TRACE_CAPACITY env / the
+        built-in default decides. 0 disables native recording."""
+        cap = self.raw.get("observability", {}).get("traceCapacity")
+        return None if cap is None else int(cap)
+
     @classmethod
     def from_dict(cls, cfg: dict) -> "NodeConfig":
         cfg = migrate(cfg)

@@ -179,17 +179,22 @@ class Devnet:
     # -- era loop ----------------------------------------------------------------
     def run_era(self, era: int, max_messages: int = 2_000_000) -> List[Block]:
         """Run one consensus era to completion on every node."""
-        for router in self.net.routers:
-            router.advance_era(era)
-        pid = M.RootProtocolId(era=era)
-        for i in range(self.n):
-            self.net.post_request(i, pid, None)
-        ok = self.net.run(
-            lambda: all(
-                r.result_of(pid) is not None for r in self.net.routers
-            ),
-            max_messages=max_messages,
-        )
+        from ..utils import tracing
+
+        # the era span is the flight recorder's attribution window: the
+        # era report and the clock-alignment tests anchor on it
+        with tracing.span("era", era=era):
+            for router in self.net.routers:
+                router.advance_era(era)
+            pid = M.RootProtocolId(era=era)
+            for i in range(self.n):
+                self.net.post_request(i, pid, None)
+            ok = self.net.run(
+                lambda: all(
+                    r.result_of(pid) is not None for r in self.net.routers
+                ),
+                max_messages=max_messages,
+            )
         if not ok:
             raise RuntimeError(f"era {era} did not complete")
         blocks = [r.result_of(pid) for r in self.net.routers]

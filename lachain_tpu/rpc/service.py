@@ -580,6 +580,15 @@ class RpcService:
 
         return tracing.summary()
 
+    def la_getEraReport(self):
+        """Per-era phase attribution (propose/RBC/BA/coin/TPKE-verify/
+        TPKE-decrypt/commit + idle), merged from the Python span ring and
+        the native engines' flight-recorder rings. The input for deciding
+        what to overlap when pipelining eras."""
+        from ..utils import tracing
+
+        return tracing.era_report()
+
     def validator_status(self):
         vsm = self.node.validator_status
         return {

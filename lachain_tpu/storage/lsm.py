@@ -32,8 +32,10 @@ from __future__ import annotations
 import ctypes
 import os
 import signal
+import struct
 import subprocess
 import threading
+import weakref
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from .kv import KVStore
@@ -54,7 +56,24 @@ _STAT_FIELDS = (
     "table_count",
     "memtable_bytes",
     "imm_memtables",
+    "compact_backlog",  # tables beyond the compaction trigger point
+    "trace_dropped",    # flight-recorder ring evictions
 )
+
+# lsm.cpp trace record contract: 32-byte big-endian records, same frame as
+# the consensus engine (u64 ts_ns, u64 dur_ns, u32 kind, u32 tid, u32 a, b)
+_TRACE_RECORD = struct.Struct(">QQIIII")
+_LK_NAMES = {
+    20: "wal_encode",  # a = payload bytes
+    21: "wal_fsync",   # a = group-commit records, b = bytes written
+    22: "memtable_seal",  # a = bytes, b = new WAL segment
+    23: "memtable_flush",  # a = bytes, b = sst seq
+    24: "compaction",  # a = input tables, b = output seq
+}
+_LT_NAMES = {0: "caller", 1: "wal-writer", 2: "flusher", 3: "compactor"}
+# bytes-per-group-commit spread widely; record counts are small integers
+_GROUP_COMMIT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+_next_trace_pid = iter(range(3, 1 << 30))  # pid 1 = python, 2 = consensus
 
 
 def _load_lib():
@@ -118,7 +137,18 @@ def _load_lib():
     lib.lsm_table_count.restype = ctypes.c_uint64
     lib.lsm_table_count.argtypes = [ctypes.c_void_p]
     lib.lsm_version.restype = ctypes.c_int
-    assert lib.lsm_version() == 2
+    assert lib.lsm_version() == 3
+    lib.lsm_monotonic_ns.restype = ctypes.c_uint64
+    lib.lsm_monotonic_ns.argtypes = []
+    lib.lsm_trace_configure.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.lsm_trace_dropped.restype = ctypes.c_uint64
+    lib.lsm_trace_dropped.argtypes = [ctypes.c_void_p]
+    lib.lsm_trace_drain.restype = ctypes.c_uint64
+    lib.lsm_trace_drain.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.c_uint64,
+    ]
     _lib_cache[0] = lib
     return lib
 
@@ -158,6 +188,86 @@ class LsmKV(KVStore):
         )
         if not self._h:
             raise IOError(f"cannot open LSM store at {path!r}")
+        # flight recorder: size the engine ring, align its clock, register
+        # with the merged tracer (own pid per store; engine thread roles
+        # become named rows in the Chrome export)
+        from ..utils import tracing
+
+        self._trace_offset = tracing.clock_offset(self._lib.lsm_monotonic_ns)
+        self._trace_dropped_seen = 0
+        self._trace_pid = next(_next_trace_pid)
+        self._trace_source = f"lsm-{os.path.basename(path) or path}-{id(self):x}"
+        self._lib.lsm_trace_configure(self._h, tracing.DEFAULT_CAPACITY)
+        ref = weakref.ref(self)
+        tracing.register_native_source(
+            self._trace_source,
+            lambda: [] if ref() is None else ref()._drain_trace(),
+        )
+
+    # -- flight recorder -------------------------------------------------------
+    def trace_configure(self, capacity: int) -> None:
+        """Resize the engine-side trace ring; 0 disables recording."""
+        with self._lock:
+            if self._h:
+                self._lib.lsm_trace_configure(self._h, max(int(capacity), 0))
+
+    def _decode_trace(self, raw: bytes) -> List[dict]:
+        evs: List[dict] = []
+        for i in range(0, len(raw) - (len(raw) % 32), 32):
+            ts, dur, kind, tid, a, b = _TRACE_RECORD.unpack_from(raw, i)
+            name = _LK_NAMES.get(kind, str(kind))
+            evs.append(
+                {
+                    "name": name,
+                    "cat": "native.lsm",
+                    "start": ts / 1e9 + self._trace_offset,
+                    "end": (ts + dur) / 1e9 + self._trace_offset,
+                    "pid": self._trace_pid,
+                    "pname": self._trace_source.rsplit("-", 1)[0],
+                    "tid": tid,
+                    "tname": _LT_NAMES.get(tid, str(tid)),
+                    "args": {"a": a, "b": b},
+                }
+            )
+            if kind == 21:  # LK_WAL_FSYNC: the never-published v2 numbers
+                from ..utils import metrics
+
+                metrics.observe_hist("lsm_wal_fsync_seconds", dur / 1e9)
+                metrics.observe_hist(
+                    "lsm_wal_group_commit_records",
+                    a,
+                    buckets=_GROUP_COMMIT_BUCKETS,
+                )
+        return evs
+
+    def _drain_trace(self) -> List[dict]:
+        """Consume the engine trace ring -> merged-tracer event dicts;
+        feeds the WAL fsync/group-commit histograms and publishes native
+        ring-drop growth as trace_events_dropped_total deltas."""
+        evs: List[dict] = []
+        with self._lock:
+            if not self._h:
+                return []
+            for _ in range(4):
+                need = self._lib.lsm_trace_drain(self._h, None, 0)
+                if need == 0:
+                    break
+                buf = (ctypes.c_ubyte * (need + 4096))()
+                got = self._lib.lsm_trace_drain(self._h, buf, len(buf))
+                if got <= len(buf):
+                    evs = self._decode_trace(bytes(buf[:got]))
+                    break
+            dropped = int(self._lib.lsm_trace_dropped(self._h))
+        if dropped > self._trace_dropped_seen:
+            from ..utils import metrics
+
+            metrics.inc(
+                "trace_events_dropped_total",
+                dropped - self._trace_dropped_seen,
+                labels={"source": "lsm"},
+            )
+            self._trace_dropped_seen = dropped
+        return evs
 
     def get(self, key: bytes) -> Optional[bytes]:
         val = ctypes.POINTER(ctypes.c_ubyte)()
@@ -304,8 +414,19 @@ class LsmKV(KVStore):
         metrics.set_gauge("lsm_compactions_total", stats["compactions"])
         metrics.set_gauge("lsm_wal_fsyncs_total", stats["wal_fsyncs"])
         metrics.set_gauge("lsm_wal_records_total", stats["wal_records"])
+        # sustained non-zero backlog with compactions flat = starved compactor
+        metrics.set_gauge("lsm_compaction_backlog", stats["compact_backlog"])
 
     def close(self) -> None:
+        from ..utils import tracing
+
+        # pull buffered engine events (and the fsync/group-commit histogram
+        # samples they carry) into the merged tracer before the ring dies
+        try:
+            tracing.drain_native()
+        except Exception:
+            pass
+        tracing.unregister_native_source(self._trace_source)
         with self._lock:
             if self._h:
                 self._lib.lsm_close(self._h)

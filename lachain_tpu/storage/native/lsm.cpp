@@ -743,6 +743,85 @@ struct TableCursor {
 };
 
 // ---------------------------------------------------------------------------
+// Flight-recorder trace ring (shared 32-byte big-endian record layout with
+// consensus/native/consensus_rt.cpp and utils/tracing.py). Unlike the
+// consensus engine this store is multi-threaded, so the ring takes its own
+// leaf mutex and every record carries the emitting thread's role as its tid
+// — the merge layer renders those as named threads (wal writer / flusher /
+// compactor) in the Chrome trace. Timestamps are raw CLOCK_MONOTONIC ns;
+// lsm_monotonic_ns anchors the Python clock-offset handshake.
+// ---------------------------------------------------------------------------
+
+static inline u64 trace_now_ns() {
+  return (u64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+enum LsmTraceKind : u32 {
+  LK_WAL_ENQ = 20,    // span: record encode (crc+frame); a=payload bytes
+  LK_WAL_FSYNC = 21,  // span: write+fsync; a=group-commit records, b=bytes
+  LK_SEAL = 22,       // instant: memtable sealed; a=bytes, b=new segment
+  LK_FLUSH = 23,      // span: memtable -> SST; a=bytes, b=sst seq
+  LK_COMPACT = 24,    // span: full merge; a=input tables, b=output seq
+};
+
+enum LsmTraceTid : u32 {
+  LT_CALLER = 0,  // API caller thread (write/seal path)
+  LT_WAL = 1,
+  LT_FLUSHER = 2,
+  LT_COMPACTOR = 3,
+};
+
+struct TraceEvent {
+  u64 ts_ns, dur_ns;
+  u32 kind, tid, a, b;
+};
+
+struct TraceRing {
+  std::mutex mu;  // leaf lock: push/drain only, never acquires another
+  std::vector<TraceEvent> buf;
+  size_t cap = 16384;
+  size_t w = 0, count = 0;
+  u64 dropped = 0;
+  std::atomic<bool> enabled{true};
+
+  void configure(size_t capacity) {
+    std::lock_guard<std::mutex> g(mu);
+    buf.clear();
+    w = count = 0;
+    cap = capacity;
+    enabled.store(capacity > 0, std::memory_order_relaxed);
+  }
+  void push(u64 ts, u64 dur, u32 kind, u32 tid, u32 a, u32 b) {
+    if (!enabled.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> g(mu);
+    if (!cap) return;
+    if (buf.size() != cap) buf.resize(cap);
+    buf[w] = {ts, dur, kind, tid, a, b};
+    w = (w + 1) % cap;
+    if (count < cap)
+      count++;
+    else
+      dropped++;  // overwrote the oldest unread record
+  }
+};
+
+static inline void trace_put32(std::string& out, u32 v) {
+  char b[4] = {(char)(v >> 24), (char)(v >> 16), (char)(v >> 8), (char)v};
+  out.append(b, 4);
+}
+
+static inline void trace_put64(std::string& out, u64 v) {
+  trace_put32(out, (u32)(v >> 32));
+  trace_put32(out, (u32)v);
+}
+
+static inline u32 trace_clamp32(u64 v) {
+  return v > 0xFFFFFFFFull ? 0xFFFFFFFFu : (u32)v;
+}
+
+// ---------------------------------------------------------------------------
 // Engine
 // ---------------------------------------------------------------------------
 
@@ -774,6 +853,7 @@ struct Lsm {
   std::vector<std::unique_ptr<Table>> tables;  // oldest..newest
   BlockCache cache;
   Stats stats;
+  TraceRing trace;  // flight recorder (own leaf mutex, see TraceRing)
   bool io_failed = false;  // a background flush failed: fail fast, loudly
 
   // WAL writer — guarded by wal_mu
@@ -976,9 +1056,14 @@ struct Lsm {
       std::string buf;
       buf.swap(wal_pending);
       u64 through = wal_enqueued;
+      u64 batch = through - wal_durable;  // group-commit size (records)
       int fd = wal_fd;
       lk.unlock();
+      u64 t0 = trace_now_ns();
       bool ok = write_all(fd, buf.data(), buf.size()) && ::fsync(fd) == 0;
+      if (ok)
+        trace.push(t0, trace_now_ns() - t0, LK_WAL_FSYNC, LT_WAL,
+                   trace_clamp32(batch), trace_clamp32(buf.size()));
       lk.lock();
       if (!ok) {
         wal_error = true;
@@ -993,11 +1078,14 @@ struct Lsm {
 
   // caller holds mu (ordering: mu -> wal_mu). Returns the record's seq.
   u64 wal_enqueue_locked(const u8* payload, size_t len) {
+    u64 t0 = trace_now_ns();
     std::string rec;
     rec.reserve(len + 8);
     put_u32(rec, crc32(payload, len));
     put_u32(rec, (u32)len);
     rec.append((const char*)payload, len);
+    trace.push(t0, trace_now_ns() - t0, LK_WAL_ENQ, LT_CALLER,
+               trace_clamp32(len), 0);
     std::lock_guard<std::mutex> g(wal_mu);
     wal_pending += rec;
     u64 seq = ++wal_enqueued;
@@ -1040,6 +1128,8 @@ struct Lsm {
       ::close(wal_fd);
       wal_fd = nfd;
     }
+    trace.push(trace_now_ns(), 0, LK_SEAL, LT_CALLER,
+               trace_clamp32(mem->bytes), trace_clamp32(seg));
     imm.push_back(std::move(mem));
     mem = std::make_unique<Memtable>();
     mem->wal_segment = seg;
@@ -1123,7 +1213,11 @@ struct Lsm {
       bool only = tables.empty();
       lk.unlock();
       // the sealed memtable is immutable: stream it without the lock
+      u64 t0 = trace_now_ns();
       auto table = flush_memtable_to_sst(m, seq, tid, only);
+      if (table)
+        trace.push(t0, trace_now_ns() - t0, LK_FLUSH, LT_FLUSHER,
+                   trace_clamp32(m->bytes), trace_clamp32(seq));
       lk.lock();
       if (!table) {
         // an unflushable memtable is a hard fault: writers fail fast
@@ -1252,6 +1346,7 @@ struct Lsm {
       seq = next_seq++;
       tid = next_table_id++;
     }
+    u64 trace_t0 = trace_now_ns();
     Throttle th{compact_rate_mbps, std::chrono::steady_clock::now()};
     TableBuilder b;
     if (!b.open(table_path(seq))) return false;
@@ -1291,7 +1386,11 @@ struct Lsm {
         while (cur[i].valid && cur[i].key() == key) cur[i].step();
     }
     if (!b.finish()) return false;
-    if (!swap) return true;  // debug: orphan output left for open() to eat
+    if (!swap) {  // debug: orphan output left for open() to eat
+      trace.push(trace_t0, trace_now_ns() - trace_t0, LK_COMPACT,
+                 LT_COMPACTOR, (u32)n_in, trace_clamp32(seq));
+      return true;
+    }
     auto t = std::make_unique<Table>();
     t->path = table_path(seq);
     t->id = tid;
@@ -1319,6 +1418,8 @@ struct Lsm {
       }
       stats.compactions++;
     }
+    trace.push(trace_t0, trace_now_ns() - trace_t0, LK_COMPACT, LT_COMPACTOR,
+               (u32)n_in, trace_clamp32(seq));
     return true;
   }
 
@@ -1487,7 +1588,7 @@ struct Lsm {
   }
 
   void fill_stats(u64* out, int n) {
-    u64 v[10] = {0};
+    u64 v[12] = {0};
     {
       std::lock_guard<std::mutex> g(mu);
       v[0] = stats.bloom_neg;
@@ -1498,13 +1599,22 @@ struct Lsm {
       v[7] = tables.size();
       v[8] = mem ? mem->bytes : 0;
       v[9] = imm.size();
+      // compaction backlog: tables beyond the trigger point — a sustained
+      // non-zero value with compactions flat means the compactor is starved
+      v[10] = tables.size() > compact_tables
+                  ? tables.size() - compact_tables
+                  : 0;
     }
     {
       std::lock_guard<std::mutex> g(wal_mu);
       v[4] = stats_wal_fsyncs;
       v[5] = stats.wal_records;
     }
-    for (int i = 0; i < n && i < 10; i++) out[i] = v[i];
+    {
+      std::lock_guard<std::mutex> g(trace.mu);
+      v[11] = trace.dropped;
+    }
+    for (int i = 0; i < n && i < 12; i++) out[i] = v[i];
   }
 };
 
@@ -1607,6 +1717,51 @@ u64 lsm_table_count(void* h) {
   return (u64)db->tables.size();
 }
 
-int lsm_version() { return 2; }
+// -- flight recorder ---------------------------------------------------------
+
+// Raw CLOCK_MONOTONIC now, for the Python clock-offset handshake.
+u64 lsm_monotonic_ns() { return trace_now_ns(); }
+
+// capacity 0 disables recording
+void lsm_trace_configure(void* h, u64 capacity) {
+  static_cast<Lsm*>(h)->trace.configure((size_t)capacity);
+}
+
+u64 lsm_trace_dropped(void* h) {
+  Lsm* db = static_cast<Lsm*>(h);
+  std::lock_guard<std::mutex> g(db->trace.mu);
+  return db->trace.dropped;
+}
+
+// Two-call drain: size query with buf == NULL, then the copying call, which
+// CONSUMES the ring. Same 32-byte big-endian record layout as the consensus
+// engine's rt_trace_drain (u64 ts_ns, u64 dur_ns, u32 kind/tid/a/b).
+// Background threads keep appending between the two calls, so callers
+// should over-allocate; a too-small buffer returns the new size needed.
+u64 lsm_trace_drain(void* h, u8* buf, u64 cap) {
+  Lsm* db = static_cast<Lsm*>(h);
+  TraceRing& r = db->trace;
+  std::lock_guard<std::mutex> g(r.mu);
+  std::string out;
+  out.reserve(r.count * 32);
+  if (r.count) {
+    size_t start = (r.w + r.cap - r.count) % r.cap;
+    for (size_t i = 0; i < r.count; i++) {
+      const TraceEvent& e = r.buf[(start + i) % r.cap];
+      trace_put64(out, e.ts_ns);
+      trace_put64(out, e.dur_ns);
+      trace_put32(out, e.kind);
+      trace_put32(out, e.tid);
+      trace_put32(out, e.a);
+      trace_put32(out, e.b);
+    }
+  }
+  if (!buf || out.size() > cap) return out.size();
+  std::memcpy(buf, out.data(), out.size());
+  r.count = 0;  // consumed (w stays: the ring keeps filling from there)
+  return out.size();
+}
+
+int lsm_version() { return 3; }
 
 }  // extern "C"

@@ -16,21 +16,57 @@ protocol (and flush/persist phase) it is stuck inside.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _lock = threading.Lock()
 _ids = itertools.count(1)
 # finished spans, oldest evicted first; 8192 spans ≈ a few dozen eras at
-# N=16 — enough history to explain a stall without unbounded growth
-DEFAULT_CAPACITY = 8192
+# N=16 — enough history to explain a stall without unbounded growth.
+# LACHAIN_TRACE_CAPACITY (env, or config observability.traceCapacity via
+# set_capacity) resizes both this ring and the native-engine rings.
+DEFAULT_CAPACITY = int(os.environ.get("LACHAIN_TRACE_CAPACITY") or 8192)
 _done: deque = deque(maxlen=DEFAULT_CAPACITY)
 _open: "Dict[int, _Span]" = {}
 # monotonic epoch so exported timestamps are small positive microseconds
 _epoch = time.monotonic()
+
+# -- native flight-recorder merge state --------------------------------------
+# Sources (the native consensus engine, each native LSM store) register a
+# drain callback returning ready-made event dicts: {name, cat, start, end,
+# args, pid, tid, tname, [replace_key]}. `start`/`end` are time.monotonic()
+# seconds (the source applies its clock-offset handshake before handing
+# events over). Events carrying `replace_key` are cumulative snapshots
+# (per-era dispatch-phase totals): only the latest per key is kept.
+_native_sources: "Dict[str, Callable[[], List[dict]]]" = {}
+_native_done: deque = deque(maxlen=DEFAULT_CAPACITY)
+_native_acc: Dict[tuple, dict] = {}
+# ring evictions (silent truncation made visible: satellite of ISSUE 6)
+_py_dropped = 0
+
+
+def _count_drop(n: int = 1) -> None:
+    """Caller holds _lock. Mirrors the drop into the metrics registry."""
+    global _py_dropped
+    _py_dropped += n
+    try:
+        from . import metrics
+
+        metrics.inc(
+            "trace_events_dropped_total", n, labels={"source": "python"}
+        )
+    except Exception:  # metrics must never break the recorder
+        pass
+
+
+def dropped_total() -> int:
+    """Python-ring evictions since start (native rings report their own)."""
+    with _lock:
+        return _py_dropped
 
 
 class _Span:
@@ -84,6 +120,8 @@ def end(sid: int, **args) -> None:
         sp.end = time.monotonic()
         if args:
             sp.args.update(args)
+        if _done.maxlen is not None and len(_done) == _done.maxlen:
+            _count_drop()
         _done.append(sp)
 
 
@@ -92,6 +130,8 @@ def instant(name: str, cat: str = "era", **args) -> None:
     sp = _Span(next(_ids), name, cat, time.monotonic(), args)
     sp.end = sp.start
     with _lock:
+        if _done.maxlen is not None and len(_done) == _done.maxlen:
+            _count_drop()
         _done.append(sp)
 
 
@@ -139,25 +179,143 @@ def snapshot(limit: Optional[int] = None) -> List[dict]:
     return out
 
 
+# -- native flight-recorder merge --------------------------------------------
+
+
+def clock_offset(native_now_ns: Callable[[], int], samples: int = 5) -> float:
+    """Seconds to ADD to a native engine's monotonic ns/1e9 so its
+    timestamps land on this tracer's time.monotonic axis. Both clocks are
+    CLOCK_MONOTONIC on Linux, but the handshake keeps the alignment honest
+    where the epochs differ: bracket the native read with two monotonic
+    reads and keep the tightest bracket's midpoint."""
+    best_width, best_off = None, 0.0
+    for _ in range(max(samples, 1)):
+        t0 = time.monotonic()
+        ns = native_now_ns()
+        t1 = time.monotonic()
+        if best_width is None or (t1 - t0) < best_width:
+            best_width = t1 - t0
+            best_off = (t0 + t1) / 2 - ns / 1e9
+    return best_off
+
+
+def register_native_source(name: str, fn: Callable[[], List[dict]]) -> None:
+    """Register a drain callback for a native engine's trace ring.
+
+    `fn` returns event dicts with monotonic-aligned `start`/`end` seconds
+    (the binding applies its clock-offset handshake), plus `pid`, `tid`,
+    `pname`, `tname` lane hints for the Chrome export. Re-registering a
+    name replaces the previous callback (engine restart)."""
+    with _lock:
+        _native_sources[name] = fn
+
+
+def unregister_native_source(name: str) -> None:
+    with _lock:
+        _native_sources.pop(name, None)
+
+
+def drain_native() -> None:
+    """Pull pending events out of every registered native ring into the
+    merged buffer. Cheap when rings are empty; callers sprinkle this at
+    quiescent points (era end, snapshot/export time)."""
+    with _lock:
+        sources = list(_native_sources.items())
+    for name, fn in sources:
+        try:
+            evs = fn()
+        except Exception:
+            # a closed engine must not poison the recorder; the owner
+            # unregisters on close, this covers teardown races
+            continue
+        if not evs:
+            continue
+        with _lock:
+            for ev in evs:
+                key = ev.get("replace_key")
+                if key is not None:
+                    # cumulative snapshot (dispatch-phase totals):
+                    # latest per key wins, no ring growth
+                    _native_acc[key] = ev
+                    continue
+                if (
+                    _native_done.maxlen is not None
+                    and len(_native_done) == _native_done.maxlen
+                ):
+                    _count_drop()
+                _native_done.append(ev)
+
+
+def native_snapshot() -> List[dict]:
+    """Drained native events (plus latest cumulative accumulators) as
+    plain dicts, oldest first. Triggers a drain."""
+    drain_native()
+    with _lock:
+        out = list(_native_done) + list(_native_acc.values())
+    out.sort(key=lambda d: (d.get("start", 0.0), d.get("tid", 0)))
+    return [dict(d) for d in out]
+
+
+PY_PID = 1  # Python host process lane group in the Chrome export
+
+
+def _assign_lanes(spans: List[dict]) -> List[tuple]:
+    """Per-category, nesting-preserving lane assignment.
+
+    Within one category, each lane holds a stack of enclosing span end
+    times: a span may join a lane only if the lane is idle at its start
+    or the span nests fully inside the lane's innermost open span.
+    Overlapping-but-not-nested spans (concurrent protocol instances)
+    therefore land on separate rows, while parent/child pairs stay
+    stacked on one row so Perfetto renders real nesting.
+
+    Returns [(span_dict, category, lane_index)], input order preserved.
+    """
+    lanes_by_cat: Dict[str, List[List[float]]] = {}
+    out = []
+    for d in spans:
+        cat = d["cat"] or "default"
+        lanes = lanes_by_cat.setdefault(cat, [])
+        placed = None
+        for idx, stack in enumerate(lanes):
+            while stack and stack[-1] <= d["start"]:
+                stack.pop()
+            if not stack or d["end"] <= stack[-1]:
+                stack.append(d["end"])
+                placed = idx
+                break
+        if placed is None:
+            placed = len(lanes)
+            lanes.append([d["end"]])
+        out.append((d, cat, placed))
+    return out
+
+
 def to_chrome_trace(limit: Optional[int] = None) -> dict:
     """Chrome trace_event JSON (load in chrome://tracing / Perfetto).
 
-    All events share one pid; tid is a lane assigned greedily so spans
-    that overlap in time (concurrent protocol instances) land on separate
-    rows instead of rendering as a false stack."""
-    events = []
-    # lane -> end time of the last span placed there
-    lanes: List[float] = []
-    for d in snapshot(limit):
-        start_us = (d["start"] - _epoch) * 1e6
-        dur_us = max((d["end"] - d["start"]) * 1e6, 0.0)
-        for tid, busy_until in enumerate(lanes):
-            if d["start"] >= busy_until:
-                lanes[tid] = d["end"]
-                break
-        else:
-            tid = len(lanes)
-            lanes.append(d["end"])
+    Python-host spans render under pid=1 with one labeled thread-row
+    group per category (nesting preserved; concurrent instances fan out
+    to numbered sibling rows). Drained native-engine events render under
+    their own pids with the engine's real thread roles (WAL writer,
+    flusher, compactor, per-validator dispatch) as named rows, so one
+    export shows the whole cross-language timeline."""
+    events: List[dict] = []
+    # (pid, tid) -> row label; pid -> process label
+    thread_names: Dict[tuple, str] = {}
+    proc_names: Dict[int, str] = {PY_PID: "python-host"}
+
+    tid_of: Dict[tuple, int] = {}
+
+    def py_tid(cat: str, lane: int) -> int:
+        key = (cat, lane)
+        if key not in tid_of:
+            tid_of[key] = len(tid_of) + 1
+            label = cat if lane == 0 else f"{cat}#{lane}"
+            thread_names[(PY_PID, tid_of[key])] = label
+        return tid_of[key]
+
+    for d, cat, lane in _assign_lanes(snapshot(limit)):
         args = dict(d["args"])
         if d["open"]:
             args["open"] = True
@@ -166,14 +324,58 @@ def to_chrome_trace(limit: Optional[int] = None) -> dict:
                 "name": d["name"],
                 "cat": d["cat"],
                 "ph": "X",
-                "pid": 1,
-                "tid": tid,
-                "ts": round(start_us, 1),
-                "dur": round(dur_us, 1),
+                "pid": PY_PID,
+                "tid": py_tid(cat, lane),
+                "ts": round((d["start"] - _epoch) * 1e6, 1),
+                "dur": round(max((d["end"] - d["start"]) * 1e6, 0.0), 1),
                 "args": args,
             }
         )
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    for ev in native_snapshot():
+        pid = int(ev.get("pid", 2))
+        tid = int(ev.get("tid", 0))
+        if ev.get("pname"):
+            proc_names[pid] = ev["pname"]
+        if ev.get("tname"):
+            thread_names[(pid, tid)] = ev["tname"]
+        events.append(
+            {
+                "name": ev["name"],
+                "cat": ev.get("cat", "native"),
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "ts": round((ev["start"] - _epoch) * 1e6, 1),
+                "dur": round(
+                    max((ev["end"] - ev["start"]) * 1e6, 0.0), 1
+                ),
+                "args": dict(ev.get("args") or {}),
+            }
+        )
+
+    meta: List[dict] = []
+    for pid, label in sorted(proc_names.items()):
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": label},
+            }
+        )
+    for (pid, tid), label in sorted(thread_names.items()):
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": label},
+            }
+        )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def summary() -> dict:
@@ -192,15 +394,213 @@ def summary() -> dict:
     return agg
 
 
+# -- era phase attribution ---------------------------------------------------
+
+# Report columns, and the precedence used when intervals overlap: a span
+# counted as TPKE decrypt (device call) wins over the protocol span it is
+# nested inside. Idle is derived (wall − attributed), so the table always
+# sums to era wall time up to clamp error.
+PHASES = (
+    "propose",
+    "rbc",
+    "ba",
+    "coin",
+    "tpke_verify",
+    "tpke_decrypt",
+    "commit",
+)
+_PHASE_PRIORITY = {
+    "tpke_decrypt": 0,
+    "tpke_verify": 1,
+    "propose": 2,
+    "commit": 3,
+    "coin": 4,
+    "ba": 5,
+    "rbc": 6,
+}
+
+# Python span name -> phase. Parent/orchestrator spans (era, HoneyBadger,
+# CommonSubset, RootProtocol) are deliberately absent: their time is the
+# sum of their children plus idle, so attributing them would double count.
+_SPAN_PHASE = {
+    "ReliableBroadcast": "rbc",
+    "BinaryAgreement": "ba",
+    "BinaryBroadcast": "ba",
+    "CommonCoin": "coin",
+    "hb.era_decrypt": "tpke_decrypt",
+    "hb.apply_era_results": "tpke_decrypt",
+}
+
+# Native crossing op name -> phase (see consensus/native_hosts.py XO_NAMES).
+_CROSS_PHASE = {
+    "coin_sign": "coin",
+    "coin_combine": "coin",
+    "coin_result": "coin",
+    "hb_acs": "tpke_verify",
+    "hb_queue": "tpke_decrypt",
+    "hb_done": "tpke_decrypt",
+    "root_input": "propose",
+    "root_sign": "commit",
+    "root_verify": "commit",
+    "root_produce": "commit",
+}
+
+# Native dispatch-phase accumulator name -> phase (TK_PHASE records;
+# exclusive message-dispatch time measured inside the C++ engine).
+_DISPATCH_PHASE = {
+    "rbc": "rbc",
+    "ba": "ba",
+    "coin": "coin",
+    "tpke": "tpke_decrypt",
+    "commit": "commit",
+}
+
+
+def _sweep(intervals: List[tuple], lo: float, hi: float) -> Dict[str, float]:
+    """Exclusive per-phase time from possibly-overlapping phase intervals,
+    clipped to [lo, hi]; where intervals overlap the highest-priority
+    phase owns the time (so nested spans never double count)."""
+    edges = {lo, hi}
+    clipped = []
+    for phase, s, e in intervals:
+        s, e = max(s, lo), min(e, hi)
+        if e > s:
+            clipped.append((phase, s, e))
+            edges.add(s)
+            edges.add(e)
+    cuts = sorted(edges)
+    out = {p: 0.0 for p in PHASES}
+    for i in range(len(cuts) - 1):
+        s, e = cuts[i], cuts[i + 1]
+        best = None
+        for phase, ps, pe in clipped:
+            if ps <= s and pe >= e:
+                if best is None or (
+                    _PHASE_PRIORITY[phase] < _PHASE_PRIORITY[best]
+                ):
+                    best = phase
+        if best is not None:
+            out[best] += e - s
+    return out
+
+
+def era_report(
+    spans: Optional[List[dict]] = None,
+    native: Optional[List[dict]] = None,
+) -> dict:
+    """Per-era phase attribution: where does era wall time go?
+
+    Combines three sources: Python protocol/crypto spans (interval sweep
+    with nesting priority), native crossing events (batched crypto ops,
+    from the drained consensus ring), and the engine's per-era exclusive
+    dispatch accumulators. Idle = wall − attributed, clamped at 0. The
+    direct input for deciding what to overlap when pipelining eras
+    (ROADMAP item 1)."""
+    if spans is None:
+        spans = snapshot()
+    if native is None:
+        native = native_snapshot()
+
+    # era window = union over every node's "era" span for that era number
+    windows: Dict[int, List[float]] = {}
+    for d in spans:
+        if d["name"] == "era" and d["args"].get("era") is not None:
+            era = int(d["args"]["era"])
+            w = windows.setdefault(era, [d["start"], d["end"]])
+            w[0] = min(w[0], d["start"])
+            w[1] = max(w[1], d["end"])
+
+    per_era_iv: Dict[int, List[tuple]] = {e: [] for e in windows}
+    for d in spans:
+        phase = _SPAN_PHASE.get(d["name"])
+        era = d["args"].get("era")
+        if phase is None or era is None or int(era) not in per_era_iv:
+            continue
+        per_era_iv[int(era)].append((phase, d["start"], d["end"]))
+
+    dispatch: Dict[int, Dict[str, float]] = {}
+    for ev in native:
+        era = (ev.get("args") or {}).get("era")
+        if era is None or int(era) not in windows:
+            continue
+        era = int(era)
+        if ev.get("cat") == "native.cross":
+            phase = _CROSS_PHASE.get((ev.get("args") or {}).get("op"))
+            if phase is not None:
+                per_era_iv[era].append((phase, ev["start"], ev["end"]))
+        elif ev.get("cat") == "native.phase":
+            phase = _DISPATCH_PHASE.get((ev.get("args") or {}).get("phase"))
+            if phase is not None:
+                acc = dispatch.setdefault(era, {})
+                acc[phase] = acc.get(phase, 0.0) + float(
+                    (ev.get("args") or {}).get("dur_ns", 0)
+                ) / 1e9
+
+    eras = []
+    for era in sorted(windows):
+        lo, hi = windows[era]
+        wall = max(hi - lo, 0.0)
+        phases = _sweep(per_era_iv[era], lo, hi)
+        # engine dispatch time is measured OUTSIDE the crossing callbacks
+        # (cross time subtracted natively), so it is exclusive of every
+        # interval above and adds linearly
+        for phase, secs in dispatch.get(era, {}).items():
+            phases[phase] += secs
+        attributed = sum(phases.values())
+        idle = max(wall - attributed, 0.0)
+        eras.append(
+            {
+                "era": era,
+                "wall_s": round(wall, 6),
+                "phases_s": {p: round(phases[p], 6) for p in PHASES},
+                "idle_s": round(idle, 6),
+                "attributed_s": round(attributed, 6),
+                "coverage": round(
+                    (attributed + idle) / wall, 4
+                ) if wall > 0 else 1.0,
+            }
+        )
+    return {"eras": eras, "phases": list(PHASES)}
+
+
+def era_report_table(report: Optional[dict] = None) -> str:
+    """Plain-text per-era phase table (CLI `trace --era-report`)."""
+    if report is None:
+        report = era_report()
+    cols = ["era", "wall_s"] + list(PHASES) + ["idle_s"]
+    rows = [cols]
+    for ent in report["eras"]:
+        rows.append(
+            [str(ent["era"]), f"{ent['wall_s']:.3f}"]
+            + [f"{ent['phases_s'][p]:.3f}" for p in PHASES]
+            + [f"{ent['idle_s']:.3f}"]
+        )
+    if len(rows) == 1:
+        return "<no completed eras in trace ring>"
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = [
+        "  ".join(cell.rjust(widths[i]) for i, cell in enumerate(row))
+        for row in rows
+    ]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def set_capacity(n: int) -> None:
-    """Resize the finished-span ring (keeps the newest spans)."""
-    global _done
+    """Resize the merged span rings (keeps the newest spans). Native
+    in-engine ring capacities are configured via their bindings."""
+    global _done, _native_done
     with _lock:
         _done = deque(_done, maxlen=max(int(n), 1))
+        _native_done = deque(_native_done, maxlen=max(int(n), 1))
 
 
 def reset_for_tests() -> None:
-    global _done
+    global _done, _native_done, _py_dropped
     with _lock:
         _done = deque(maxlen=DEFAULT_CAPACITY)
         _open.clear()
+        _native_done = deque(maxlen=DEFAULT_CAPACITY)
+        _native_acc.clear()
+        _native_sources.clear()
+        _py_dropped = 0

@@ -1,0 +1,318 @@
+"""Cross-language flight recorder: native trace rings drained into the
+Python tracer, clock alignment across the language boundary, per-era
+phase attribution, and the compare.py perf-regression gate.
+
+The determinism tests pin the ISSUE-6 contract: two identical seeded
+runs must produce identical native event SEQUENCES (kinds/lanes/args —
+timestamps excluded, they are wall-clock), because the rings sit on the
+same deterministic engine the bit-identity tests already pin.
+"""
+import json
+import random
+
+import pytest
+
+from lachain_tpu.utils import metrics, tracing
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.reset_for_tests()
+    metrics.reset_all_for_tests()
+    yield
+    tracing.reset_for_tests()
+    metrics.reset_all_for_tests()
+
+
+class _Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _run_native_hb(era_span: bool = True):
+    """One seeded HoneyBadger era on the native engine; returns the
+    drained native events (the network is closed before return)."""
+    from lachain_tpu.consensus import messages as M
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.consensus.native_rt import NativeSimulatedNetwork
+
+    n, f = 4, 1
+    pub, privs = trusted_key_gen(n, f, rng=_Rng(7))
+    net = NativeSimulatedNetwork(pub, privs, era=0, seed=11)
+    pid = M.HoneyBadgerId(era=0)
+
+    def drive():
+        for i in range(n):
+            net.post_request(i, pid, b"payload|%d|" % i + bytes(16))
+        assert net.run(
+            lambda: all(r.result_of(pid) is not None for r in net.routers)
+        )
+
+    if era_span:
+        with tracing.span("era", era=0):
+            drive()
+    else:
+        drive()
+    evs = tracing.native_snapshot()
+    net.close()
+    return evs
+
+
+def _signature(evs):
+    """Determinism signature: everything except wall-clock values. The
+    cumulative dispatch accumulators keep their phase/era identity but
+    drop their ns totals (those are timings)."""
+    out = []
+    for e in evs:
+        args = {
+            k: v for k, v in e["args"].items() if k not in ("dur_ns",)
+        }
+        out.append((e["name"], e["cat"], e["tid"], tuple(sorted(args.items()))))
+    return out
+
+
+def test_native_drain_deterministic_across_identical_runs():
+    first = _signature(_run_native_hb())
+    tracing.reset_for_tests()
+    second = _signature(_run_native_hb())
+    assert first, "native ring produced no events"
+    assert first == second
+
+
+def test_native_events_inside_enclosing_era_span():
+    """Clock alignment: after the offset handshake, no native event may
+    land outside the Python era span that encloses the whole run."""
+    evs = _run_native_hb(era_span=True)
+    era = next(
+        s for s in tracing.snapshot() if s["name"] == "era"
+    )
+    assert not era["open"]
+    eps = 5e-3  # ring flush happens inside the span; 5 ms covers jitter
+    consensus = [e for e in evs if e["pid"] == 2]
+    assert consensus
+    for e in consensus:
+        assert e["start"] >= era["start"] - eps, e
+        assert e["end"] <= era["end"] + eps, e
+        assert e["end"] >= e["start"]
+
+
+def test_merged_chrome_trace_has_named_native_threads():
+    """Acceptance shape: native engine events render under their own pid
+    with labeled thread rows next to the Python host lanes."""
+    _run_native_hb()
+    out = tracing.to_chrome_trace()
+    x = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in out["traceEvents"] if e["ph"] == "M"]
+    native = [e for e in x if e["pid"] == 2]
+    assert native, "no native events in the merged export"
+    assert any(e["pid"] == 1 for e in x), "python host lanes missing"
+    procs = {
+        m["pid"]: m["args"]["name"]
+        for m in meta
+        if m["name"] == "process_name"
+    }
+    assert procs.get(2) == "native-consensus"
+    threads = {
+        (m["pid"], m["tid"]): m["args"]["name"]
+        for m in meta
+        if m["name"] == "thread_name"
+    }
+    for e in native:
+        assert (e["pid"], e["tid"]) in threads
+    json.loads(json.dumps(out))
+
+
+def test_era_report_phases_sum_to_wall_time():
+    """Attribution invariant: phases + idle ≈ era wall time (<=10% off,
+    the acceptance tolerance) and the known-busy phases are non-zero on
+    a native run."""
+    _run_native_hb(era_span=True)
+    report = tracing.era_report()
+    assert [e["era"] for e in report["eras"]] == [0]
+    ent = report["eras"][0]
+    assert ent["wall_s"] > 0
+    total = sum(ent["phases_s"].values()) + ent["idle_s"]
+    assert abs(total - ent["wall_s"]) <= 0.10 * ent["wall_s"]
+    # TPKE share verification crosses into Python on every native run
+    assert ent["phases_s"]["tpke_verify"] > 0
+    # and the engine's dispatch accumulators give the rbc/ba split
+    assert ent["phases_s"]["rbc"] > 0
+
+
+def test_era_report_table_renders():
+    _run_native_hb(era_span=True)
+    table = tracing.era_report_table()
+    lines = table.splitlines()
+    assert len(lines) >= 3  # header, rule, one era row
+    for col in ("era", "wall_s", "rbc", "tpke_verify", "idle_s"):
+        assert col in lines[0]
+
+
+def test_trace_ring_drop_counter_python_source():
+    tracing.set_capacity(8)
+    try:
+        for i in range(40):
+            tracing.instant("tick", i=i)
+    finally:
+        tracing.set_capacity(tracing.DEFAULT_CAPACITY)
+    assert (
+        metrics.counter_value(
+            "trace_events_dropped_total", labels={"source": "python"}
+        )
+        == 32
+    )
+    assert tracing.dropped_total() == 32
+
+
+def test_lsm_flight_recorder_events_and_histograms(tmp_path):
+    """The v2 engine numbers that were never published: WAL group-commit
+    batch size + fsync latency histograms, the compaction-backlog gauge,
+    and engine thread events in the merged trace."""
+    from lachain_tpu.storage.lsm import LsmKV
+
+    kv = LsmKV(str(tmp_path / "db"))
+    try:
+        for i in range(50):
+            kv.write_batch([(b"k%04d" % i, b"v" * 64)])
+        kv.flush()
+        stats = kv.stats()
+        assert "compact_backlog" in stats and "trace_dropped" in stats
+        evs = tracing.native_snapshot()
+        names = {e["name"] for e in evs}
+        assert {"wal_encode", "wal_fsync", "memtable_seal"} <= names
+        fsync = next(e for e in evs if e["name"] == "wal_fsync")
+        assert fsync["tname"] == "wal-writer"
+        assert fsync["pid"] >= 3  # own process lane, not python/consensus
+        assert metrics.histogram_snapshot("lsm_wal_fsync_seconds")["count"] > 0
+        gc = metrics.histogram_snapshot("lsm_wal_group_commit_records")
+        assert gc["count"] > 0 and gc["sum"] >= gc["count"]
+        assert metrics.gauge_value("lsm_compaction_backlog") is not None
+    finally:
+        kv.close()
+    # the close() unregistered the source: snapshots stay quiet afterwards
+    assert all(
+        not s.startswith("lsm-") for s in tracing._native_sources
+    )
+
+
+def test_native_ring_capacity_and_drop_counter():
+    """A tiny native ring overflows, the drop counter grows, and the
+    drained metric reports the native source."""
+    from lachain_tpu.consensus import messages as M
+    from lachain_tpu.consensus.keys import trusted_key_gen
+    from lachain_tpu.consensus.native_rt import NativeSimulatedNetwork
+
+    pub, privs = trusted_key_gen(4, 1, rng=_Rng(7))
+    net = NativeSimulatedNetwork(pub, privs, era=0, seed=11)
+    net.trace_configure(4)  # tiny ring: events must be dropped
+    pid = M.HoneyBadgerId(era=0)
+    for i in range(4):
+        net.post_request(i, pid, b"payload|%d|" % i + bytes(16))
+    assert net.run(
+        lambda: all(r.result_of(pid) is not None for r in net.routers)
+    )
+    tracing.drain_native()
+    assert net.trace_dropped() > 0
+    assert (
+        metrics.counter_value(
+            "trace_events_dropped_total", labels={"source": "consensus"}
+        )
+        > 0
+    )
+    net.close()
+
+
+# -- compare.py regression gate ----------------------------------------------
+
+
+def _result(value=1000.0, era_s=0.5, spread=5.0, metric="x_per_s"):
+    return {
+        "metric": metric,
+        "value": value,
+        "tpu_era_s": era_s,
+        "trial_spread_pct": spread,
+    }
+
+
+def _gate(tmp_path, base, cur, *extra):
+    import benchmarks.compare as compare
+
+    b = tmp_path / "base.json"
+    c = tmp_path / "cur.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cur))
+    return compare.main([str(b), str(c), *extra])
+
+
+def test_compare_clean_run_passes(tmp_path):
+    assert _gate(tmp_path, _result(), _result(value=990.0, era_s=0.51)) == 0
+
+
+def test_compare_regression_fails(tmp_path):
+    # >=20% era-latency regression vs a 15.6%-spread baseline must gate
+    base = _result(spread=15.6)
+    bad = _result(value=800.0, era_s=0.62, spread=15.6)
+    assert _gate(tmp_path, base, bad) == 1
+
+
+def test_compare_noise_widens_gate(tmp_path):
+    # the same 20% delta passes when the runs themselves are that noisy
+    base = _result(spread=30.0)
+    cur = _result(value=800.0, era_s=0.6, spread=5.0)
+    assert _gate(tmp_path, base, cur) == 0
+
+
+def test_compare_direction_lower_is_better(tmp_path):
+    base = _result(metric="consensus_sim_era_latency_s", value=10.0)
+    worse = _result(metric="consensus_sim_era_latency_s", value=12.0)
+    better = _result(metric="consensus_sim_era_latency_s", value=8.0)
+    assert _gate(tmp_path, base, worse) == 1
+    assert _gate(tmp_path, base, better) == 0
+
+
+def test_compare_wrapper_and_schema_errors(tmp_path):
+    import benchmarks.compare as compare
+
+    # the checked-in BENCH_r05.json driver envelope is accepted
+    wrapped = {"cmd": "python bench.py", "rc": 0, "parsed": _result()}
+    b = tmp_path / "wrapped.json"
+    b.write_text(json.dumps(wrapped))
+    c = tmp_path / "cur.json"
+    c.write_text(json.dumps(_result()))
+    assert compare.main([str(b), str(c)]) == 0
+    # metric mismatch and garbage input are schema errors, not passes
+    d = tmp_path / "other.json"
+    d.write_text(json.dumps(_result(metric="different_metric")))
+    assert compare.main([str(b), str(d)]) == 2
+    e = tmp_path / "garbage.json"
+    e.write_text("not json at all")
+    assert compare.main([str(b), str(e)]) == 2
+
+
+def test_rpc_and_cli_era_report_surface():
+    """la_getEraReport returns the merged report shape, and the trace CLI
+    accepts --era-report (the devnet runbook path)."""
+    from lachain_tpu.rpc.service import RpcService
+
+    _run_native_hb(era_span=True)
+    report = RpcService.la_getEraReport(object())
+    assert report["phases"] == list(tracing.PHASES)
+    assert report["eras"] and report["eras"][0]["era"] == 0
+    # the table renderer consumes the RPC JSON round trip unchanged
+    table = tracing.era_report_table(json.loads(json.dumps(report)))
+    assert "tpke_verify" in table.splitlines()[0]
+
+
+def test_compare_checked_in_baseline_self_gate():
+    """The Makefile bench-gate wiring: BENCH_r05.json vs itself passes."""
+    import os
+
+    import benchmarks.compare as compare
+
+    base = os.path.join(os.path.dirname(__file__), "..", "BENCH_r05.json")
+    assert compare.main([base, base]) == 0

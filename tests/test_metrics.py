@@ -3,7 +3,11 @@ breadcrumbs (reference TimeBenchmark/DefaultCrypto.cs:47-69,
 AbstractProtocol.cs:113-135, MetricsService.cs:7-26)."""
 import time
 
+import pytest
+
 from lachain_tpu.utils import metrics
+
+pytestmark = pytest.mark.observability
 
 
 def test_measure_and_snapshot_reset():

@@ -8,6 +8,8 @@ import pytest
 
 from lachain_tpu.utils import metrics, tracing
 
+pytestmark = pytest.mark.observability
+
 
 @pytest.fixture(autouse=True)
 def _clean():
@@ -54,23 +56,47 @@ def test_chrome_trace_export_overlapping_lanes():
     tracing.end(a)
     out = tracing.to_chrome_trace()
     assert out["displayTimeUnit"] == "ms"
-    events = out["traceEvents"]
+    events = [e for e in out["traceEvents"] if e["ph"] == "X"]
     assert len(events) == 2
     for ev in events:
-        assert ev["ph"] == "X"
         assert ev["ts"] >= 0 and ev["dur"] >= 0
-    # the RBC span overlaps the still-open era span -> separate lanes
+    # the RBC span is a different category from the era span -> its own
+    # labeled lane group, not a false stack under "era"
     era_ev = next(e for e in events if e["name"] == "era")
     rbc_ev = next(e for e in events if e["name"] == "ReliableBroadcast")
     assert era_ev["tid"] != rbc_ev["tid"]
+    # Perfetto rows are labeled via thread_name metadata events
+    meta = [e for e in out["traceEvents"] if e["ph"] == "M"]
+    names = {
+        (m["pid"], m["tid"]): m["args"]["name"]
+        for m in meta
+        if m["name"] == "thread_name"
+    }
+    assert names[(era_ev["pid"], era_ev["tid"])] == "era"
+    assert names[(rbc_ev["pid"], rbc_ev["tid"])] == "protocol"
     # the export is loadable JSON end to end
     json.loads(json.dumps(out))
+
+
+def test_chrome_trace_nesting_shares_lane_within_category():
+    """Parent/child spans of ONE category stay on one row (real nesting);
+    overlapping non-nested siblings fan out to numbered lanes."""
+    parent = tracing.begin("HoneyBadger", cat="protocol", era=2)
+    child = tracing.begin("ReliableBroadcast", cat="protocol", era=2)
+    tracing.end(child)
+    sibling = tracing.begin("BinaryAgreement", cat="protocol", era=2)
+    tracing.end(parent)  # overlaps sibling without containing its end
+    tracing.end(sibling)
+    events = [e for e in tracing.to_chrome_trace()["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert by_name["HoneyBadger"]["tid"] == by_name["ReliableBroadcast"]["tid"]
+    assert by_name["BinaryAgreement"]["tid"] != by_name["HoneyBadger"]["tid"]
 
 
 def test_open_spans_exported_and_summary():
     sid = tracing.begin("era", era=9)
     out = tracing.to_chrome_trace()
-    (ev,) = out["traceEvents"]
+    (ev,) = [e for e in out["traceEvents"] if e["ph"] == "X"]
     assert ev["args"]["open"] is True
     summ = tracing.summary()
     assert summ["era"]["count"] == 1
