@@ -8,7 +8,7 @@ PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-storage \
-	test-observability native bench bench-gate
+	test-observability test-sync native bench bench-gate
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -37,6 +37,13 @@ test-storage:
 # layer, era phase reports, Prometheus surface, compare.py gate
 test-observability:
 	$(PYTEST) $(PYTEST_ARGS) -m observability
+
+# synchronization: the multi-peer fast-sync scheduler (failover, request
+# ids, bounded frontier, bans, snapshot shipping) + the block
+# synchronizer. The slice to run after touching core/fast_sync.py,
+# core/synchronizer.py or the trie-serving wire kinds
+test-sync:
+	$(PYTEST) $(PYTEST_ARGS) -m "sync and not slow"
 
 test:
 	$(PYTEST) $(PYTEST_ARGS)

@@ -35,6 +35,7 @@ LATENCY_FIELDS = (
     "tpu_host_s",
     "baseline_era_s",
     "per_node_normalized_latency_s",
+    "fastsync_failover_recovery_s",
 )
 
 

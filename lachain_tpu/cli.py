@@ -231,13 +231,24 @@ async def _run_node(cfg, args) -> None:
         # reference Application.Start: FastSynchronizerBatch BEFORE the
         # block synchronizer, so replay doesn't race the state download
         await asyncio.sleep(1.0)  # let peer connections establish
-        for peer in peers:
-            try:
-                h = await node.fast_sync.sync(peer.public_key, timeout=120)
-                print(f"fast-synced to height {h}", flush=True)
-                break
-            except Exception as e:
-                logger.warning("fast sync via %s failed: %s", peer.host, e)
+        checkpoint = getattr(args, "trusted_checkpoint", None)
+        if checkpoint:
+            height_s, hash_s = checkpoint.split(":", 1)
+            node.fast_sync.trusted = (
+                int(height_s),
+                bytes.fromhex(hash_s.removeprefix("0x")),
+            )
+        try:
+            # all configured peers form the serving set: the scheduler
+            # spreads batches across them and fails over on its own
+            h = await node.fast_sync.sync(
+                [peer.public_key for peer in peers],
+                timeout=120,
+                snapshot=bool(getattr(args, "snapshot", False)),
+            )
+            print(f"fast-synced to height {h}", flush=True)
+        except Exception as e:
+            logger.warning("fast sync failed: %s", e)
         node.start_services()
     rpc = None
     if cfg.rpc.enabled:
@@ -592,6 +603,9 @@ def cmd_height(args) -> int:
         json.dumps(
             {
                 "height": node.block_manager.current_height(),
+                # the committed state root: the --expect-root value for
+                # db import and the operator's cross-node consistency check
+                "stateHash": node.state.committed.state_hash().hex(),
                 "chainId": node.chain_id,
                 "validators": node.public_keys.n,
             }
@@ -656,9 +670,34 @@ def cmd_db(args) -> int:
                         batch = []
                 if batch:
                     kv.write_batch(batch)
+            # migration/snapshot contract: a dump is not self-certifying.
+            # The imported tip's state roots must hash to the operator-
+            # supplied --expect-root (read from a trusted block header);
+            # without the flag a non-empty import is refused outright.
+            from .storage.fsck import verify_imported_state
+
+            expect = getattr(args, "expect_root", None)
+            expect_hash = (
+                bytes.fromhex(expect.removeprefix("0x")) if expect else None
+            )
+            problem = (
+                verify_imported_state(kv, expect_hash) if count else None
+            )
         finally:
             kv.close()
-        print(json.dumps({"imported": count, "engine": cfg.storage_engine}))
+        if problem is not None:
+            # remove the refused store so a corrected re-run is not
+            # blocked by the freshness check above
+            import shutil
+
+            if os.path.isdir(db_path):
+                shutil.rmtree(db_path, ignore_errors=True)
+            elif os.path.exists(db_path):
+                os.remove(db_path)
+            print(f"import verification failed: {problem}", file=sys.stderr)
+            return 1
+        print(json.dumps({"imported": count, "engine": cfg.storage_engine,
+                          "verifiedRoot": expect or None}))
         return 0
 
     if not os.path.exists(db_path):
@@ -868,7 +907,21 @@ def main(argv=None) -> int:
     rn.add_argument(
         "--fast-sync",
         action="store_true",
-        help="download state from a peer instead of replaying blocks",
+        help="download state from the configured peers instead of "
+        "replaying blocks (multi-peer, with failover)",
+    )
+    rn.add_argument(
+        "--snapshot",
+        action="store_true",
+        help="with --fast-sync: bulk-import a snapshot stream first, "
+        "then trie-walk only the diff",
+    )
+    rn.add_argument(
+        "--trusted-checkpoint",
+        metavar="HEIGHT:BLOCKHASH",
+        help="with --fast-sync: accept the target block by this "
+        "checkpoint instead of a genesis-validator multisig quorum "
+        "(required once the chain has rotated validators)",
     )
     rn.set_defaults(fn=cmd_run)
 
@@ -905,6 +958,12 @@ def main(argv=None) -> int:
     )
     im.add_argument("--config", required=True)
     im.add_argument("--dump", required=True)
+    im.add_argument(
+        "--expect-root",
+        help="state hash (hex) from a trusted block header that the "
+        "imported tip must match; without it a non-empty import is "
+        "refused — the dump is never trusted blindly",
+    )
     im.set_defaults(fn=cmd_db)
 
     en = sub.add_parser("encrypt", help="password-protect a wallet file")

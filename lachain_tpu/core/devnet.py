@@ -19,14 +19,24 @@ from ..consensus.keys import trusted_key_gen
 from ..consensus.root_protocol import RootProtocol
 from ..consensus.simulator import DeliveryMode, SimulatedNetwork
 from ..crypto import ecdsa
-from ..storage.kv import KVStore, MemoryKV
+from ..crypto.hashes import keccak256
+from ..storage.kv import EntryPrefix, KVStore, MemoryKV, prefixed
 from ..storage.state import StateManager
+from ..utils.serialization import write_u64
 from . import system_contracts
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
-from .execution import TransactionExecuter, get_balance, get_nonce
+from .execution import TransactionExecuter, get_balance, get_nonce, set_balance
 from .tx_pool import TransactionPool
-from .types import Block, SignedTransaction, Transaction, sign_transaction
+from .types import (
+    ZERO_HASH,
+    Block,
+    BlockHeader,
+    MultiSig,
+    SignedTransaction,
+    Transaction,
+    sign_transaction,
+)
 
 DEFAULT_CHAIN_ID = 225  # our own chain id
 
@@ -220,3 +230,159 @@ class Devnet:
 
     def height(self, node: int = 0) -> int:
         return self.nodes[node].block_manager.current_height()
+
+
+# -- fast-sync fixtures -------------------------------------------------------
+# Deterministic chain fabrication for the state-download tests: a genesis +
+# one properly multisigned block whose state trie carries an arbitrary number
+# of synthetic accounts. Everything derives from (keys, seed, accounts), so
+# the same fixture can be rebuilt bit-identically in another process — the
+# real-SIGKILL fast-sync test runs serving validators as subprocesses that
+# regenerate the exact same store from the same arguments.
+
+
+def fixture_account(seed: int, i: int) -> bytes:
+    """The i-th synthetic 20-byte address of a fabricated fixture."""
+    return keccak256(b"devnet-fixture" + write_u64(seed) + write_u64(i))[:20]
+
+
+def fabricate_chain_store(
+    public_keys,
+    private_keys,
+    *,
+    chain_id: int = DEFAULT_CHAIN_ID,
+    accounts: int = 0,
+    initial_balances: Optional[Dict[bytes, int]] = None,
+    seed: int = 7,
+    kv: Optional[KVStore] = None,
+):
+    """Genesis + a signed block 1 holding `accounts` synthetic balances.
+
+    Returns (kv, block1, roots). The block carries an N-F validator
+    multisig over its header, so a fast-syncing observer that knows the
+    genesis validator set accepts it without a trusted checkpoint. The
+    per-account addresses come from fixture_account(seed, i) — tests can
+    spot-check balances without materializing the whole set.
+    """
+    kv = kv if kv is not None else MemoryKV()
+    state = StateManager(kv)
+    bm = BlockManager(kv, state, system_contracts.make_executer(chain_id))
+    genesis = bm.build_genesis(
+        dict(initial_balances or {}),
+        chain_id,
+        validator_pubs=list(public_keys.ecdsa_pub_keys),
+    )
+    snap = state.new_snapshot()
+    for i in range(accounts):
+        set_balance(snap, fixture_account(seed, i), 10_000 + i)
+    roots = snap.freeze()
+    header = BlockHeader(
+        index=1,
+        prev_block_hash=genesis.hash(),
+        merkle_root=ZERO_HASH,
+        state_hash=roots.state_hash(),
+        nonce=0,
+    )
+    hh = header.hash()
+    quorum = public_keys.n - public_keys.f
+    sigs = tuple(
+        (i, ecdsa.sign_hash(private_keys[i].ecdsa_priv, hh))
+        for i in range(quorum)
+    )
+    block = Block(header=header, tx_hashes=(), multisig=MultiSig(sigs))
+    kv.write_batch(
+        [
+            (prefixed(EntryPrefix.BLOCK_BY_HASH, block.hash()), block.encode()),
+            (
+                prefixed(EntryPrefix.BLOCK_HASH_BY_HEIGHT, write_u64(1)),
+                block.hash(),
+            ),
+        ]
+    )
+    state.commit(1, roots)
+    return kv, block, roots
+
+
+def clone_store(src: KVStore, dst: Optional[KVStore] = None) -> KVStore:
+    """Copy every row of `src` into `dst` (fresh MemoryKV by default).
+
+    Fabricating a 100k-node fixture once and cloning it into each serving
+    validator's store is an order of magnitude cheaper than rebuilding the
+    trie per node — and content addressing makes the copies exact replicas.
+    """
+    dst = dst if dst is not None else MemoryKV()
+    dst.ingest(list(src.scan_prefix(b"")))
+    return dst
+
+
+def run_fixture_server(
+    *,
+    n: int = 4,
+    f: int = 1,
+    index: int = 0,
+    seed: int = 0,
+    fixture_seed: int = 7,
+    accounts: int = 0,
+    chain_id: int = DEFAULT_CHAIN_ID,
+    port: int = 0,
+) -> None:
+    """Subprocess entry point: serve a fabricated chain over real TCP.
+
+    Regenerates the (deterministic) validator keys and fixture store from
+    the same arguments the parent test used, starts a full Node on
+    127.0.0.1, prints one JSON line {"port": ..., "pub": ...} so the parent
+    can connect, then serves until killed — the parent SIGKILLs it
+    mid-download to exercise real-process failover.
+    """
+    import asyncio
+    import json
+    import sys
+
+    rng = random.Random(seed)
+
+    class _Rng:
+        def randbelow(self, k):
+            return rng.randrange(k)
+
+    public_keys, private_keys = trusted_key_gen(n, f, rng=_Rng())
+    kv, _block, _roots = fabricate_chain_store(
+        public_keys,
+        private_keys,
+        chain_id=chain_id,
+        accounts=accounts,
+        seed=fixture_seed,
+    )
+
+    async def _serve() -> None:
+        from .node import Node
+
+        node = Node(
+            index=index,
+            public_keys=public_keys,
+            private_keys=private_keys[index],
+            chain_id=chain_id,
+            kv=kv,
+            port=port,
+            flush_interval=0.01,
+        )
+        # serving throughput is not what the failover tests measure: the
+        # default serve throttle would read as timeouts on a hammering
+        # observer and get the SURVIVOR declared dead
+        node.fast_sync.serve_rate = 1e9
+        node.fast_sync.serve_capacity = 1e9
+        await node.start(start_synchronizer=False)
+        print(
+            json.dumps(
+                {
+                    "port": node.address.port,
+                    "pub": node.address.public_key.hex(),
+                }
+            ),
+            flush=True,
+        )
+        await asyncio.Event().wait()  # serve until the parent kills us
+
+    try:
+        asyncio.run(_serve())
+    except KeyboardInterrupt:  # pragma: no cover - parent teardown
+        sys.exit(0)

@@ -1,4 +1,4 @@
-"""Fast state sync: trie-node download instead of block replay.
+"""Fast state sync: multi-peer trie-node download instead of block replay.
 
 Parity with the reference's fast synchronizer
 (/root/reference/src/Lachain.Core/Network/FastSynchronizerBatch.cs:13-50,
@@ -17,29 +17,167 @@ it, so a malicious peer cannot substitute state. Trust roots:
     verify deep rotations without replaying them)
   * the downloaded roots must hash to the block header's state_hash
 
-Flow: pick best peer -> fast_sync_request -> verify block + roots ->
-BFS-download missing trie nodes in batches (hash-verified, resumable by
-construction: present nodes are skipped) -> commit roots at the target
-height -> normal BlockSynchronizer continues from there.
+Download scheduler (reference RequestManager.cs): a bounded BFS frontier
+feeds up to `max_inflight` concurrent batches spread across every live
+serving peer. Each request carries a request id, so a late or duplicated
+reply can never be attributed to the wrong batch. A timed-out batch is
+requeued and retried against a different peer (the failed peer backs off
+with seeded jitter); a peer that serves a node not hashing to its request
+is banned for the session; a peer that times out repeatedly is declared
+dead. The sync only fails when no live serving peer remains.
+
+Frontier memory is bounded: at most `frontier_cap` discovered-but-not-
+fetched hashes are held in RAM, the overflow is spilled to KV rows
+(EntryPrefix.FASTSYNC_FRONTIER) and restored as memory drains, so a
+100k+-node trie syncs in O(cap) frontier memory. (The dedup set of seen
+hashes is 32 bytes per node and stays in RAM.)
+
+Bulk path (`snapshot=True`): before the trie walk, pull the peer's whole
+trie-node keyspace in cursor-addressed pages (resumable from any other
+peer mid-stream — the cursor is just the last node hash), import the
+records content-addressed, then run the normal walk over the (ideally
+empty) diff. A snapshot can never poison state: records that do not hash
+correctly are unreachable garbage, and the walk re-downloads whatever
+the snapshot missed — node-by-node fallback is the walk itself.
 """
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Dict, List, Optional, Set, Tuple
+import random
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..crypto.hashes import keccak256
 from ..network import wire
 from ..storage.kv import EntryPrefix, prefixed
 from ..utils import metrics
 from ..storage.state import StateRoots
-from ..storage.trie import EMPTY_ROOT, InternalNode
+from ..storage.trie import EMPTY_ROOT, InternalNode, _decode as _trie_decode
 from .synchronizer import verify_block_multisig
 from .types import Block
 
 logger = logging.getLogger(__name__)
 
 BATCH = 256  # node hashes per request (reference batch download workers)
+FRONTIER_CAP = 4096  # in-memory frontier hashes before spilling to KV
+HASH_LEN = 32
+
+
+class BoundedFrontier:
+    """BFS frontier with bounded resident memory.
+
+    At most `cap` hashes live in the in-memory deque; overflow spills to
+    KV rows under EntryPrefix.FASTSYNC_FRONTIER (chunked, newest-first)
+    and is restored as the deque drains. Rows are deleted on restore and
+    `clear()` removes the whole keyspace on sync completion — leftovers
+    after a mid-sync crash are repairable garbage that fsck prunes.
+    """
+
+    def __init__(self, kv, cap: int = FRONTIER_CAP, chunk: int = 2048):
+        self.kv = kv
+        self.cap = max(2, cap)
+        self.chunk = max(1, min(chunk, self.cap // 2))
+        self._mem: Deque[bytes] = deque()
+        self._seen = set()
+        self._lo = 0  # [lo, hi) = live spill row ids
+        self._hi = 0
+        self._spilled = 0
+        self.peak = 0  # max resident frontier size (the bounded claim)
+        self.spilled_total = 0
+
+    def __len__(self) -> int:
+        return len(self._mem) + self._spilled
+
+    @staticmethod
+    def _row_key(idx: int) -> bytes:
+        return prefixed(EntryPrefix.FASTSYNC_FRONTIER, idx.to_bytes(8, "big"))
+
+    def push(self, h: bytes) -> None:
+        if h in self._seen:
+            return
+        self._seen.add(h)
+        self._mem.append(h)
+        self._overflow()
+
+    def requeue(self, hashes: List[bytes]) -> None:
+        """Retry path: hashes already seen but still unfetched go back to
+        the FRONT so a failed batch is retried before new discoveries."""
+        self._mem.extendleft(reversed(hashes))
+        self._overflow()
+
+    def pop_many(self, n: int) -> List[bytes]:
+        out: List[bytes] = []
+        while len(out) < n:
+            if not self._mem and not self._restore():
+                break
+            out.append(self._mem.popleft())
+        return out
+
+    def _overflow(self) -> None:
+        while len(self._mem) > self.cap:
+            take = min(self.chunk, len(self._mem) - self.cap // 2)
+            batch = [self._mem.pop() for _ in range(take)]
+            self.kv.put(self._row_key(self._hi), b"".join(batch))
+            self._hi += 1
+            self._spilled += take
+            self.spilled_total += take
+            metrics.inc("fastsync_frontier_spilled_total", take)
+        self.peak = max(self.peak, len(self._mem))
+
+    def _restore(self) -> bool:
+        if self._spilled == 0:
+            return False
+        self._hi -= 1  # newest row first: depth-first drain of the spill
+        key = self._row_key(self._hi)
+        data = self.kv.get(key) or b""
+        self.kv.delete(key)
+        hashes = [
+            data[i : i + HASH_LEN] for i in range(0, len(data), HASH_LEN)
+        ]
+        self._spilled -= len(hashes)
+        self._mem.extend(hashes)
+        self.peak = max(self.peak, len(self._mem))
+        return bool(hashes)
+
+    def clear(self) -> None:
+        for i in range(self._lo, self._hi):
+            self.kv.delete(self._row_key(i))
+        self._lo = self._hi = self._spilled = 0
+        self._mem.clear()
+        self._seen.clear()
+
+
+@dataclass
+class PeerScore:
+    """Per-session serving-peer scoreboard (mirrored into labeled
+    fastsync_peer_* metrics)."""
+
+    served: int = 0
+    timeouts: int = 0
+    bad_nodes: int = 0
+    misses: int = 0
+    banned: bool = False
+    dead: bool = False
+    consecutive_failures: int = 0
+    backoff_until: float = 0.0
+
+    def live(self) -> bool:
+        return not (self.banned or self.dead)
+
+
+@dataclass
+class _Request:
+    peer: bytes
+    hashes: List[bytes]
+    deadline: float
+
+
+def _plabel(pub: bytes) -> Dict[str, str]:
+    return {"peer": pub.hex()[:16]}
 
 
 class FastSynchronizer:
@@ -56,18 +194,68 @@ class FastSynchronizer:
         self.node = node
         self.trusted = trusted
         self.batch = batch
+        # scheduler knobs (tests and operators tune these)
+        self.max_inflight = 4
+        self.frontier_cap = FRONTIER_CAP
+        self.request_timeout = 5.0
+        self.backoff_base = 0.5
+        self.backoff_cap = 10.0
+        self.peer_death_threshold = 4
+        # serving-side token bucket, in trie nodes (not requests): refills
+        # serve_rate nodes/s per sender up to serve_capacity burst
+        self.serve_rate = 4096.0
+        self.serve_capacity = 8192.0
+        self.snapshot_page = 4096  # records per snapshot pull page
+        self.snapshot_max_bytes = 4 << 20  # byte cap per page
+        self._serve_buckets: Dict[bytes, Tuple[float, float]] = {}
+        # seeded jitter: deterministic per node identity, like the worker
+        # reconnect backoff
+        self._rng = random.Random(zlib.crc32(node.network.public_key))
+        # block/roots phase (single outstanding request to self._peer)
         self._reply: Optional[Tuple[Optional[Block], bytes]] = None
-        self._peer: Optional[bytes] = None  # peer of the in-flight sync
-        self._nodes_event = asyncio.Event()
+        self._peer: Optional[bytes] = None
         self._reply_event = asyncio.Event()
-        self._received: List[bytes] = []
+        # download scheduler state
+        self._inflight: Dict[int, _Request] = {}
+        self._next_rid = 1
+        self._replies: Deque[Tuple[bytes, int, List[bytes]]] = deque()
+        self._snap_replies: Deque[tuple] = deque()
+        self._wake = asyncio.Event()
+        self._scores: Dict[bytes, PeerScore] = {}
+        self._frontier: Optional[BoundedFrontier] = None
+        self._rr = 0
         net = node.network
         net.on_fast_sync_request = self._serve_fast_sync
         net.on_fast_sync_reply = self._on_fast_sync_reply
         net.on_trie_nodes_request = self._serve_trie_nodes
         net.on_trie_nodes_reply = self._on_trie_nodes_reply
+        net.on_trie_nodes_request_id = self._serve_trie_nodes_id
+        net.on_trie_nodes_reply_id = self._on_trie_nodes_reply_id
+        net.on_snapshot_request = self._serve_snapshot
+        net.on_snapshot_reply = self._on_snapshot_reply
 
     # -- serving side --------------------------------------------------------
+
+    def _serve_allow(self, sender: bytes, cost: float) -> bool:
+        """Per-sender token bucket (the message_request replay limiter
+        shape): a request costs its node count, so the limiter bounds KV
+        read work, not just request count. Over-budget requests are
+        dropped — the client's retry/failover path handles it like loss."""
+        now = time.monotonic()
+        tokens, last = self._serve_buckets.get(
+            sender, (self.serve_capacity, now)
+        )
+        tokens = min(
+            self.serve_capacity, tokens + (now - last) * self.serve_rate
+        )
+        if len(self._serve_buckets) > 4096:
+            self._serve_buckets.clear()
+        if tokens < cost:
+            self._serve_buckets[sender] = (tokens, now)
+            metrics.inc("fastsync_serve_throttled_total")
+            return False
+        self._serve_buckets[sender] = (tokens - cost, now)
+        return True
 
     def _serve_fast_sync(self, sender: bytes, height: int) -> None:
         bm = self.node.block_manager
@@ -82,14 +270,57 @@ class FastSynchronizer:
             sender, wire.fast_sync_reply(block, roots.encode())
         )
 
-    def _serve_trie_nodes(self, sender: bytes, hashes: List[bytes]) -> None:
+    def _lookup_nodes(self, hashes: List[bytes]) -> List[bytes]:
         kv = self.node.kv
         out = []
-        for h in hashes[: 4 * self.batch]:
+        for h in hashes:
             enc = kv.get(prefixed(EntryPrefix.TRIE_NODE, h))
             if enc is not None:
                 out.append(enc)
-        self.node.network.send_to(sender, wire.trie_nodes_reply(out))
+        return out
+
+    def _serve_trie_nodes(self, sender: bytes, hashes: List[bytes]) -> None:
+        # id-less kind, kept for older peers; same throttle as the id path
+        hashes = hashes[: 4 * self.batch]
+        if not self._serve_allow(sender, len(hashes)):
+            return
+        self.node.network.send_to(
+            sender, wire.trie_nodes_reply(self._lookup_nodes(hashes))
+        )
+
+    def _serve_trie_nodes_id(
+        self, sender: bytes, rid: int, hashes: List[bytes]
+    ) -> None:
+        hashes = hashes[: 4 * self.batch]
+        if not self._serve_allow(sender, len(hashes)):
+            return
+        self.node.network.send_to(
+            sender, wire.trie_nodes_reply_id(rid, self._lookup_nodes(hashes))
+        )
+
+    def _serve_snapshot(
+        self, sender: bytes, rid: int, cursor: bytes, limit: int
+    ) -> None:
+        limit = max(1, min(limit, 8192))
+        if not self._serve_allow(sender, limit):
+            return
+        prefix = prefixed(EntryPrefix.TRIE_NODE)
+        rows = self.node.kv.scan_from(prefix, cursor, limit + 1)
+        included: List[Tuple[bytes, bytes]] = []
+        total = 0
+        for k, v in rows[:limit]:
+            if included and total + len(v) > self.snapshot_max_bytes:
+                break
+            included.append((k, v))
+            total += len(v)
+        done = len(included) == len(rows)
+        next_cursor = included[-1][0][2:] if included else cursor
+        self.node.network.send_to(
+            sender,
+            wire.snapshot_reply(
+                rid, next_cursor, done, [v for _, v in included]
+            ),
+        )
 
     # -- client side ---------------------------------------------------------
 
@@ -104,30 +335,136 @@ class FastSynchronizer:
         self._reply_event.set()
 
     def _on_trie_nodes_reply(self, sender, nodes: List[bytes]) -> None:
-        if self._peer is None or sender != self._peer:
+        # the id-less reply kind is never requested by this client anymore;
+        # anything arriving here is late traffic from an abandoned exchange —
+        # exactly the reply class that used to be consumed as the current
+        # batch's answer and abort the sync
+        metrics.inc("fastsync_stale_replies_total")
+
+    def _on_trie_nodes_reply_id(
+        self, sender: bytes, rid: int, nodes: List[bytes]
+    ) -> None:
+        if rid not in self._inflight:
+            metrics.inc("fastsync_stale_replies_total")
             return
-        self._received.extend(nodes)
-        self._nodes_event.set()
+        self._replies.append((sender, rid, nodes))
+        self._wake.set()
+
+    def _on_snapshot_reply(
+        self, sender: bytes, rid: int, next_cursor: bytes, done: bool, records
+    ) -> None:
+        self._snap_replies.append((sender, rid, next_cursor, done, records))
+        self._wake.set()
+
+    # -- scoreboard ----------------------------------------------------------
+
+    def _score(self, pub: bytes) -> PeerScore:
+        s = self._scores.get(pub)
+        if s is None:
+            s = self._scores[pub] = PeerScore()
+        return s
+
+    @property
+    def scoreboard(self) -> Dict[bytes, PeerScore]:
+        """Per-peer serving stats for the current/most recent session."""
+        return dict(self._scores)
+
+    def _live(self, pub: bytes) -> bool:
+        return self._score(pub).live()
+
+    def _backoff(self, s: PeerScore) -> None:
+        base = self.backoff_base * (2 ** min(s.consecutive_failures - 1, 5))
+        jitter = 0.75 + 0.5 * self._rng.random()
+        s.backoff_until = time.monotonic() + min(
+            self.backoff_cap, base * jitter
+        )
+
+    def _penalize(self, pub: bytes, *, timeout: bool) -> None:
+        s = self._score(pub)
+        s.consecutive_failures += 1
+        if timeout:
+            s.timeouts += 1
+            metrics.inc("fastsync_request_timeouts_total")
+            metrics.inc("fastsync_peer_timeouts_total", labels=_plabel(pub))
+        self._backoff(s)
+        if s.consecutive_failures >= self.peer_death_threshold and not s.dead:
+            s.dead = True
+            logger.warning(
+                "fast sync: peer %s unresponsive after %d failures, "
+                "failing over to remaining peers",
+                pub.hex()[:16],
+                s.consecutive_failures,
+            )
+
+    def _ban(self, pub: bytes, bad: int) -> None:
+        s = self._score(pub)
+        s.bad_nodes += bad
+        metrics.inc(
+            "fastsync_peer_bad_nodes_total", bad, labels=_plabel(pub)
+        )
+        if not s.banned:
+            s.banned = True
+            metrics.inc("fastsync_peer_banned_total", labels=_plabel(pub))
+            logger.warning(
+                "fast sync: peer %s served %d nodes not hashing to their "
+                "request — banned for this session",
+                pub.hex()[:16],
+                bad,
+            )
+
+    # -- sync orchestration --------------------------------------------------
 
     async def sync(
-        self, peer_pub: bytes, height: int = 0, timeout: float = 60.0
+        self,
+        peers,
+        height: int = 0,
+        timeout: float = 60.0,
+        *,
+        snapshot: bool = False,
     ) -> int:
-        """Download the state at `height` (0 = peer's tip) from `peer_pub`.
-        Returns the synced height. Raises on verification failure."""
-        node = self.node
+        """Download the state at `height` (0 = serving peers' tip) from
+        `peers` — one ECDSA pubkey or a list of them. Returns the synced
+        height. Raises on verification failure, or when no live serving
+        peer remains. `timeout` bounds the block/roots handshake; batch
+        pacing is governed by `request_timeout`/backoff."""
+        if isinstance(peers, (bytes, bytearray)):
+            peers = [bytes(peers)]
+        peers = list(dict.fromkeys(bytes(p) for p in peers))
+        if not peers:
+            raise ValueError("fast sync needs at least one serving peer")
+        self._scores = {p: PeerScore() for p in peers}
+        self._inflight.clear()
+        self._replies.clear()
+        self._snap_replies.clear()
         self._reply = None
-        self._peer = peer_pub
-        self._reply_event.clear()
         try:
-            return await self._sync_inner(peer_pub, height, timeout)
+            return await self._sync_inner(peers, height, timeout, snapshot)
         finally:
             self._peer = None  # stop accepting replies once the sync ends
+            self._inflight.clear()
 
-    async def _sync_inner(self, peer_pub: bytes, height: int, timeout: float) -> int:
+    async def _sync_inner(
+        self, peers: List[bytes], height: int, timeout: float, snapshot: bool
+    ) -> int:
         node = self.node
-        node.network.send_to(peer_pub, wire.fast_sync_request(height))
-        await asyncio.wait_for(self._reply_event.wait(), timeout)
-        block, roots_enc = self._reply or (None, b"")
+        block, roots_enc = None, b""
+        # block/roots handshake: ask peers one at a time until one answers
+        per_peer = max(1.0, timeout / max(1, len(peers)))
+        for p in peers:
+            self._reply = None
+            self._peer = p
+            self._reply_event.clear()
+            node.network.send_to(p, wire.fast_sync_request(height))
+            try:
+                await asyncio.wait_for(self._reply_event.wait(), per_peer)
+            except asyncio.TimeoutError:
+                self._penalize(p, timeout=True)
+                continue
+            block, roots_enc = self._reply or (None, b"")
+            if block is not None:
+                break
+            self._score(p).misses += 1
+        self._peer = None
         if block is None:
             raise ValueError("peer served no fast-sync snapshot")
         target = block.header.index
@@ -146,10 +483,16 @@ class FastSynchronizer:
                 "(provide a trusted checkpoint for rotated chains)"
             )
 
-        downloaded = await self._download_nodes(peer_pub, roots, timeout)
+        if snapshot:
+            complete = await self._import_snapshot(peers)
+            if not complete:
+                logger.warning(
+                    "fast sync: snapshot import incomplete — "
+                    "falling back to node-by-node download"
+                )
+        downloaded = await self._download_nodes(peers, roots)
         # install: state + block + height index (the block itself, so the
         # chain links for subsequent normal sync; tx bodies are not needed)
-        bm = node.block_manager
         node.kv.write_batch(
             [
                 (
@@ -167,70 +510,271 @@ class FastSynchronizer:
         )
         node.state.commit(target, roots)
         logger.info(
-            "fast sync complete: height %d, %d trie nodes downloaded",
+            "fast sync complete: height %d, %d trie nodes downloaded, "
+            "frontier peak %d",
             target,
             downloaded,
+            self._frontier.peak if self._frontier else 0,
         )
         return target
 
-    async def _download_nodes(
-        self, peer_pub: bytes, roots: StateRoots, timeout: float
-    ) -> int:
-        """BFS over missing nodes, batched; every node hash-verified.
-        Naturally resumable: nodes already in the KV are skipped."""
+    # -- bulk path: cursor-paged snapshot pull -------------------------------
+
+    async def _import_snapshot(self, peers: List[bytes]) -> bool:
+        """Pull the serving peers' trie-node keyspace page by page and
+        import it content-addressed. Resumes at the cursor from another
+        peer on timeout. Returns False (caller falls back to the plain
+        walk) when no live peer remains or a page makes no progress."""
         kv = self.node.kv
-        pending: List[bytes] = [
-            r for r in roots.all_roots() if r != EMPTY_ROOT
-        ]
-        seen: Set[bytes] = set(pending)
-        downloaded = 0
-        while pending:
-            want: List[bytes] = []
-            rest: List[bytes] = []
-            for h in pending:
-                if kv.get(prefixed(EntryPrefix.TRIE_NODE, h)) is not None:
-                    # already present (resume or shared subtree): still must
-                    # walk its children
-                    rest.extend(self._children_of(h, seen))
-                elif len(want) < self.batch:
-                    want.append(h)
-                else:
-                    rest.append(h)
-            if not want:
-                pending = rest
+        cursor = b""
+        while True:
+            now = time.monotonic()
+            candidates = [
+                p
+                for p in peers
+                if self._live(p) and self._score(p).backoff_until <= now
+            ]
+            if not candidates:
+                if not any(self._live(p) for p in peers):
+                    return False
+                await asyncio.sleep(0.05)
                 continue
-            self._received = []
-            self._nodes_event.clear()
+            self._rr += 1
+            peer = candidates[self._rr % len(candidates)]
+            rid = self._next_rid
+            self._next_rid += 1
             self.node.network.send_to(
-                peer_pub, wire.trie_nodes_request(want)
+                peer, wire.snapshot_request(rid, cursor, self.snapshot_page)
             )
-            await asyncio.wait_for(self._nodes_event.wait(), timeout)
-            got: Dict[bytes, bytes] = {}
-            for enc in self._received:
-                got[keccak256(enc)] = enc  # content addressing IS the proof
-            missing = [h for h in want if h not in got]
-            if missing:
-                raise ValueError(
-                    f"peer failed to serve {len(missing)} trie nodes"
-                )
+            reply = await self._wait_snapshot_reply(peer, rid)
+            if reply is None:
+                self._penalize(peer, timeout=True)
+                metrics.inc("fastsync_failovers_total")
+                continue  # same cursor, next candidate peer
+            next_cursor, done, records = reply
             puts = []
-            for h in want:
-                puts.append((prefixed(EntryPrefix.TRIE_NODE, h), got[h]))
-            kv.write_batch(puts)
-            downloaded += len(want)
-            # progress counter served by la_getDownloadedNodesTillNow
-            metrics.inc("fastsync_nodes_downloaded", len(want))
-            for h in want:
-                rest.extend(self._children_of(h, seen))
-            pending = rest
+            bad = 0
+            for enc in records:
+                try:
+                    _trie_decode(enc)
+                except Exception:
+                    bad += 1
+                    continue
+                puts.append(
+                    (prefixed(EntryPrefix.TRIE_NODE, keccak256(enc)), enc)
+                )
+            if bad:
+                self._ban(peer, bad)
+                continue
+            if records and next_cursor <= cursor and not done:
+                # a page must advance the cursor; a peer stuck in place
+                # would loop the import forever
+                self._penalize(peer, timeout=False)
+                continue
+            if puts:
+                kv.ingest(puts)
+            s = self._score(peer)
+            s.served += len(puts)
+            s.consecutive_failures = 0
+            s.backoff_until = 0.0
+            metrics.inc("fastsync_snapshot_records_total", len(puts))
+            metrics.inc("fastsync_snapshot_pages_total")
+            metrics.inc(
+                "fastsync_peer_served_total", len(puts), labels=_plabel(peer)
+            )
+            if done:
+                return True
+            if not records:
+                return False
+            cursor = next_cursor
+
+    async def _wait_snapshot_reply(self, peer: bytes, rid: int):
+        deadline = time.monotonic() + self.request_timeout
+        while True:
+            while self._snap_replies:
+                sender, r, next_cursor, done, records = (
+                    self._snap_replies.popleft()
+                )
+                if r != rid or sender != peer:
+                    metrics.inc("fastsync_stale_replies_total")
+                    continue
+                return next_cursor, done, records
+            delay = deadline - time.monotonic()
+            if delay <= 0:
+                return None
+            try:
+                await asyncio.wait_for(self._wake.wait(), delay)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+
+    # -- node-by-node path: bounded frontier + request scheduler -------------
+
+    async def _download_nodes(
+        self, peers: List[bytes], roots: StateRoots
+    ) -> int:
+        """BFS over missing nodes: up to max_inflight request-id batches
+        spread across live peers, every node hash-verified, timed-out
+        batches requeued against other peers. Naturally resumable: nodes
+        already in the KV are skipped."""
+        kv = self.node.kv
+        frontier = BoundedFrontier(kv, self.frontier_cap)
+        self._frontier = frontier
+        for r in roots.all_roots():
+            if r != EMPTY_ROOT:
+                frontier.push(r)
+        downloaded = 0
+        while len(frontier) or self._inflight:
+            now = time.monotonic()
+            self._expire_requests(frontier, now)
+            live = [p for p in peers if self._live(p)]
+            if not live:
+                raise ValueError(
+                    "fast sync aborted: no live serving peers remain"
+                )
+            while len(self._inflight) < self.max_inflight and len(frontier):
+                want = self._next_batch(frontier, kv)
+                if not want:
+                    break
+                peer = self._pick_peer(live, time.monotonic())
+                if peer is None:  # every live peer is backing off
+                    frontier.requeue(want)
+                    break
+                rid = self._next_rid
+                self._next_rid += 1
+                self._inflight[rid] = _Request(
+                    peer, want, time.monotonic() + self.request_timeout
+                )
+                metrics.inc("fastsync_requests_total")
+                self.node.network.send_to(
+                    peer, wire.trie_nodes_request_id(rid, want)
+                )
+            if not self._inflight:
+                if not len(frontier):
+                    break
+                await self._sleep_until_backoff(live)
+                continue
+            await self._wait_wake()
+            downloaded += self._drain_replies(frontier, kv)
+        frontier.clear()
+        metrics.set_gauge("fastsync_frontier_peak", frontier.peak)
         return downloaded
 
-    def _children_of(self, h: bytes, seen: Set[bytes]) -> List[bytes]:
+    def _next_batch(self, frontier: BoundedFrontier, kv) -> List[bytes]:
+        """Pop up to `batch` MISSING hashes; hashes already present (resume,
+        snapshot import, shared subtrees) are walked through inline."""
+        want: List[bytes] = []
+        while len(want) < self.batch:
+            got = frontier.pop_many(self.batch - len(want))
+            if not got:
+                break
+            for h in got:
+                if kv.get(prefixed(EntryPrefix.TRIE_NODE, h)) is not None:
+                    for c in self._children_of(h):
+                        frontier.push(c)
+                else:
+                    want.append(h)
+        return want
+
+    def _pick_peer(self, live: List[bytes], now: float) -> Optional[bytes]:
+        candidates = [
+            p for p in live if self._score(p).backoff_until <= now
+        ]
+        if not candidates:
+            return None
+        counts: Dict[bytes, int] = {}
+        for req in self._inflight.values():
+            counts[req.peer] = counts.get(req.peer, 0) + 1
+        low = min(counts.get(p, 0) for p in candidates)
+        pool = [p for p in candidates if counts.get(p, 0) == low]
+        self._rr += 1
+        return pool[self._rr % len(pool)]
+
+    def _expire_requests(
+        self, frontier: BoundedFrontier, now: float
+    ) -> None:
+        expired = [
+            rid
+            for rid, req in self._inflight.items()
+            if now >= req.deadline or not self._live(req.peer)
+        ]
+        for rid in expired:
+            req = self._inflight.pop(rid)
+            if self._live(req.peer):
+                self._penalize(req.peer, timeout=True)
+            metrics.inc("fastsync_failovers_total")
+            frontier.requeue(req.hashes)
+
+    async def _wait_wake(self) -> None:
+        now = time.monotonic()
+        deadlines = [r.deadline for r in self._inflight.values()]
+        delay = max(0.01, min(deadlines) - now) if deadlines else 0.05
+        try:
+            await asyncio.wait_for(self._wake.wait(), delay)
+        except asyncio.TimeoutError:
+            pass
+        self._wake.clear()
+
+    async def _sleep_until_backoff(self, live: List[bytes]) -> None:
+        now = time.monotonic()
+        soonest = min(self._score(p).backoff_until for p in live)
+        await asyncio.sleep(min(1.0, max(0.01, soonest - now)))
+
+    def _drain_replies(self, frontier: BoundedFrontier, kv) -> int:
+        stored = 0
+        while self._replies:
+            sender, rid, nodes = self._replies.popleft()
+            req = self._inflight.get(rid)
+            if req is None or req.peer != sender:
+                # late, duplicated, or forged reply: the request id makes it
+                # unambiguous — drop it, never consume it as another batch
+                metrics.inc("fastsync_stale_replies_total")
+                continue
+            del self._inflight[rid]
+            want = set(req.hashes)
+            got: Dict[bytes, bytes] = {}
+            bad = 0
+            for enc in nodes:
+                h = keccak256(enc)  # content addressing IS the proof
+                if h in want:
+                    got[h] = enc
+                else:
+                    bad += 1
+            s = self._score(sender)
+            if bad:
+                self._ban(sender, bad)
+            puts = []
+            for h, enc in got.items():
+                if kv.get(prefixed(EntryPrefix.TRIE_NODE, h)) is None:
+                    puts.append((prefixed(EntryPrefix.TRIE_NODE, h), enc))
+            if puts:
+                kv.write_batch(puts)
+                stored += len(puts)
+                # progress counter served by la_getDownloadedNodesTillNow
+                metrics.inc("fastsync_nodes_downloaded", len(puts))
+            if got:
+                s.served += len(got)
+                metrics.inc(
+                    "fastsync_peer_served_total",
+                    len(got),
+                    labels=_plabel(sender),
+                )
+            missing = [h for h in req.hashes if h not in got]
+            if missing:
+                s.misses += len(missing)
+                if s.live():
+                    self._penalize(sender, timeout=False)
+                frontier.requeue(missing)
+            elif not bad:
+                s.consecutive_failures = 0
+                s.backoff_until = 0.0
+            for h in got:
+                for c in self._children_of(h):
+                    frontier.push(c)
+        return stored
+
+    def _children_of(self, h: bytes) -> List[bytes]:
         node = self.node.state.trie._load(h)
-        out = []
         if isinstance(node, InternalNode):
-            for c in node.children:
-                if c != EMPTY_ROOT and c not in seen:
-                    seen.add(c)
-                    out.append(c)
-        return out
+            return [c for c in node.children if c != EMPTY_ROOT]
+        return []

@@ -139,6 +139,19 @@ class BlockSynchronizer:
             asyncio.get_event_loop().time() + self.peer_cooldown
         )
 
+    def best_peers(self, k: int = 4) -> List[bytes]:
+        """Up to `k` un-benched peers ordered by advertised height (ties
+        broken by pubkey for determinism) — the serving-peer candidate
+        set for multi-peer fast sync."""
+        now = asyncio.get_event_loop().time()
+        live = [
+            (h, pub)
+            for pub, h in self.peer_heights.items()
+            if self._benched.get(pub, 0.0) <= now
+        ]
+        live.sort(key=lambda hv: (-hv[0], hv[1]))
+        return [pub for _, pub in live[:k]]
+
     def _maybe_request(self) -> None:
         if self._request_inflight:
             now = asyncio.get_event_loop().time()

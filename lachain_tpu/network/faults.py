@@ -274,3 +274,43 @@ class TcpFrameFilter:
             metrics.inc("fault_injected_total", labels={"action": "blocked"})
             return []
         return [0.0]
+
+
+class KillSwitch:
+    """Hub frame filter that makes a node go dark on command.
+
+    `kill()` suppresses every frame in both directions from that moment
+    on — to every peer, the node looks exactly like a SIGKILLed process
+    whose kernel still holds the sockets open: sends appear to succeed
+    (injected loss must look like the network ate it) and nothing ever
+    answers. The tier-1 simulated-kill counterpart of the slow tests'
+    real SIGKILL: it exercises the same timeout/failover path without
+    the subprocess cost. Composes with an inner filter (e.g. a
+    TcpFrameFilter running a FaultPlan) applied while still alive.
+    """
+
+    def __init__(self, inner=None):
+        self.inner = inner
+        self._dead = False
+
+    def kill(self) -> None:
+        self._dead = True
+        metrics.inc("fault_injected_total", labels={"action": "killswitch"})
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def outbound(self, peer, data: bytes) -> List[float]:
+        if self._dead:
+            return []
+        if self.inner is not None:
+            return self.inner.outbound(peer, data)
+        return [0.0]
+
+    def inbound(self, data: bytes) -> List[float]:
+        if self._dead:
+            return []
+        if self.inner is not None:
+            return self.inner.inbound(data)
+        return [0.0]

@@ -57,6 +57,12 @@ class NetworkManager:
         self.on_fast_sync_reply: Optional[Callable] = None
         self.on_trie_nodes_request: Optional[Callable] = None
         self.on_trie_nodes_reply: Optional[Callable] = None
+        # request-id variants (fn(sender, request_id, ...)) + cursor-paged
+        # snapshot shipping — the multi-peer fast-sync exchange
+        self.on_trie_nodes_request_id: Optional[Callable] = None
+        self.on_trie_nodes_reply_id: Optional[Callable] = None
+        self.on_snapshot_request: Optional[Callable] = None
+        self.on_snapshot_reply: Optional[Callable] = None
         self.on_sync_blocks_reply: Optional[Callable] = None
         self.on_sync_pool_request: Optional[Callable] = None
         self.on_sync_pool_reply: Optional[Callable] = None
@@ -502,6 +508,18 @@ class NetworkManager:
             self.on_trie_nodes_request(sender, wire.parse_trie_nodes_request(msg))
         elif k == wire.KIND_TRIE_NODES_REPLY and self.on_trie_nodes_reply:
             self.on_trie_nodes_reply(sender, wire.parse_trie_nodes_reply(msg))
+        elif k == wire.KIND_TRIE_NODES_REQUEST_ID and self.on_trie_nodes_request_id:
+            rid, hashes = wire.parse_trie_nodes_request_id(msg)
+            self.on_trie_nodes_request_id(sender, rid, hashes)
+        elif k == wire.KIND_TRIE_NODES_REPLY_ID and self.on_trie_nodes_reply_id:
+            rid, nodes = wire.parse_trie_nodes_reply_id(msg)
+            self.on_trie_nodes_reply_id(sender, rid, nodes)
+        elif k == wire.KIND_SNAPSHOT_REQUEST and self.on_snapshot_request:
+            rid, cursor, limit = wire.parse_snapshot_request(msg)
+            self.on_snapshot_request(sender, rid, cursor, limit)
+        elif k == wire.KIND_SNAPSHOT_REPLY and self.on_snapshot_reply:
+            rid, next_cursor, done, records = wire.parse_snapshot_reply(msg)
+            self.on_snapshot_reply(sender, rid, next_cursor, done, records)
         elif k == wire.KIND_MESSAGE_REQUEST and self.on_message_request:
             self.on_message_request(sender, wire.parse_message_request(msg))
         elif k == wire.KIND_PEERS_REQUEST:

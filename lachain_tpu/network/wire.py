@@ -172,6 +172,20 @@ KIND_RELAY_FORWARD = 15
 # messages for an era re-requests them; the receiver replays its per-era
 # outbox (consensus/era.py) addressed to the requester
 KIND_MESSAGE_REQUEST = 16
+# request-id variants of the trie-node exchange (reference
+# RequestManager.cs: every batch carries a request id so late/duplicate
+# replies can never be attributed to the wrong in-flight batch). The
+# id-less kinds 10/11 stay served for older peers; new clients only
+# send 17 and consume 18.
+KIND_TRIE_NODES_REQUEST_ID = 17
+KIND_TRIE_NODES_REPLY_ID = 18
+# snapshot shipping: cursor-paged pull of a peer's raw trie-node rows
+# (the bulk alternative to node-by-node download; the db export/import
+# dump format reframed as a wire exchange). Pull-based paging keeps the
+# receiver in control: one page in flight per request id, resumable at
+# the cursor from a different peer mid-stream.
+KIND_SNAPSHOT_REQUEST = 19
+KIND_SNAPSHOT_REPLY = 20
 
 # reference NetworkMessagePriority: replies < consensus < pool sync
 PRIORITY = {
@@ -182,6 +196,10 @@ PRIORITY = {
     KIND_FAST_SYNC_REPLY: 0,
     KIND_TRIE_NODES_REQUEST: 2,
     KIND_TRIE_NODES_REPLY: 0,
+    KIND_TRIE_NODES_REQUEST_ID: 2,
+    KIND_TRIE_NODES_REPLY_ID: 0,
+    KIND_SNAPSHOT_REQUEST: 2,
+    KIND_SNAPSHOT_REPLY: 0,
     KIND_CONSENSUS: 1,
     KIND_PING_REQUEST: 2,
     KIND_SYNC_BLOCKS_REQUEST: 2,
@@ -403,6 +421,84 @@ def trie_nodes_reply(nodes: List[bytes]) -> NetworkMessage:
 
 def parse_trie_nodes_reply(msg: NetworkMessage) -> List[bytes]:
     return Reader(msg.body).bytes_list()
+
+
+def trie_nodes_request_id(request_id: int, hashes: List[bytes]) -> NetworkMessage:
+    """Request-id variant: the reply echoes `request_id`, so a late or
+    duplicated reply to an abandoned batch is simply dropped by the
+    scheduler instead of being consumed as the current batch's answer."""
+    return NetworkMessage(
+        KIND_TRIE_NODES_REQUEST_ID,
+        write_u64(request_id) + write_bytes_list(hashes),
+    )
+
+
+def parse_trie_nodes_request_id(msg: NetworkMessage) -> Tuple[int, List[bytes]]:
+    r = Reader(msg.body)
+    rid = r.u64()
+    hashes = r.bytes_list()
+    r.assert_eof()
+    return rid, hashes
+
+
+def trie_nodes_reply_id(request_id: int, nodes: List[bytes]) -> NetworkMessage:
+    return NetworkMessage(
+        KIND_TRIE_NODES_REPLY_ID,
+        write_u64(request_id) + write_bytes_list(nodes),
+    )
+
+
+def parse_trie_nodes_reply_id(msg: NetworkMessage) -> Tuple[int, List[bytes]]:
+    r = Reader(msg.body)
+    rid = r.u64()
+    nodes = r.bytes_list()
+    r.assert_eof()
+    return rid, nodes
+
+
+def snapshot_request(request_id: int, cursor: bytes, limit: int) -> NetworkMessage:
+    """Ask for one page of the peer's trie-node rows starting AFTER
+    `cursor` (b"" = from the beginning), at most `limit` records. The
+    cursor is a plain trie-node hash, so a partially shipped snapshot
+    resumes from any other peer."""
+    return NetworkMessage(
+        KIND_SNAPSHOT_REQUEST,
+        write_u64(request_id) + write_bytes(cursor) + write_u32(limit),
+    )
+
+
+def parse_snapshot_request(msg: NetworkMessage) -> Tuple[int, bytes, int]:
+    r = Reader(msg.body)
+    rid = r.u64()
+    cursor = r.bytes_()
+    limit = r.u32()
+    r.assert_eof()
+    return rid, cursor, limit
+
+
+def snapshot_reply(
+    request_id: int, next_cursor: bytes, done: bool, records: List[bytes]
+) -> NetworkMessage:
+    """One page of raw trie-node encodings. Records are self-certifying:
+    the importer stores each under keccak(record), so a bogus record can
+    waste bandwidth but never poison state (the root walk won't reach it)."""
+    body = (
+        write_u64(request_id)
+        + write_bytes(next_cursor)
+        + bytes([1 if done else 0])
+        + write_bytes_list(records)
+    )
+    return NetworkMessage(KIND_SNAPSHOT_REPLY, body)
+
+
+def parse_snapshot_reply(msg: NetworkMessage) -> Tuple[int, bytes, bool, List[bytes]]:
+    r = Reader(msg.body)
+    rid = r.u64()
+    next_cursor = r.bytes_()
+    done = r.raw(1)[0] != 0
+    records = r.bytes_list()
+    r.assert_eof()
+    return rid, next_cursor, done, records
 
 
 # -- peer discovery (gossip-learned addresses; reference: the hub relay

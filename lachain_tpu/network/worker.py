@@ -105,6 +105,13 @@ class ClientWorker:
             if victim is None:
                 break
             self._queued_bytes -= len(victim.body) + 6
+            # shedding must be visible: a fast-sync serving peer whose
+            # client went away sheds multi-MB snapshot/trie replies here,
+            # and a silent drop looks identical to a wire bug
+            metrics.inc(
+                "network_worker_shed_total",
+                labels={"priority": str(PRIORITY[victim.kind])},
+            )
         # wake immediately once a batch's worth is pending
         if self._queued_bytes >= self._max_batch_bytes:
             self._wakeup.set()

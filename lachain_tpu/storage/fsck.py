@@ -340,6 +340,30 @@ def fsck(
             )
         )
 
+    # fast-sync frontier spill rows: only meaningful DURING a sync; any
+    # row present at open time is leftover from a sync that died mid-
+    # download. The download itself is resumable by construction (present
+    # trie nodes are skipped), so the rows are pure garbage.
+    report.checked.append("fastsync-frontier")
+    frontier_keys = [
+        key
+        for key, _ in kv.scan_prefix(prefixed(EntryPrefix.FASTSYNC_FRONTIER))
+    ]
+    if frontier_keys:
+        if repair:
+            kv.write_batch([], frontier_keys)
+        report.issues.append(
+            FsckIssue(
+                code="fastsync-frontier",
+                severity=repairable,
+                detail=f"{len(frontier_keys)} frontier spill rows from an "
+                "interrupted fast sync",
+                repair="dropped; a restarted sync rediscovers the frontier"
+                if repair
+                else None,
+            )
+        )
+
     # shrink bookkeeping
     report.checked.append("shrink")
     shrink_state = kv.get(prefixed(EntryPrefix.SHRINK_STATE))
@@ -373,6 +397,46 @@ def fsck(
     elif not report.clean:
         logger.warning("fsck: repaired/notes: %s", report.to_dict())
     return report
+
+
+def verify_imported_state(
+    kv: KVStore, expect_state_hash: Optional[bytes]
+) -> Optional[str]:
+    """Migration/snapshot contract check for `db import`: the imported
+    store's TIP state roots must hash to `expect_state_hash` (the value
+    the operator read from a trusted block header), and the tip trie must
+    be fully present. Returns None when the store passes, else a
+    human-readable refusal reason. A dump is NOT self-certifying — only
+    the operator-supplied expectation ties it to the real chain."""
+    tip = _tip(kv)
+    if tip is None:
+        return "imported store has no committed tip height"
+    enc = kv.get(prefixed(EntryPrefix.SNAPSHOT_INDEX, write_u64(tip)))
+    if enc is None:
+        return f"imported store has no state roots at tip {tip}"
+    try:
+        roots = StateRoots.decode(enc)
+    except Exception:
+        return f"imported state roots at tip {tip} do not decode"
+    if expect_state_hash is None:
+        return (
+            "refusing to trust the dump blindly: pass --expect-root with "
+            "the state hash from a trusted block header "
+            f"(imported tip {tip} announces {roots.state_hash().hex()})"
+        )
+    if roots.state_hash() != expect_state_hash:
+        return (
+            f"imported state root mismatch at tip {tip}: expected "
+            f"{expect_state_hash.hex()}, dump contains "
+            f"{roots.state_hash().hex()}"
+        )
+    missing = _deep_trie_check(kv, [tip])
+    if missing:
+        return (
+            f"imported tip {tip} trie is incomplete: "
+            f"{len(missing)} unreachable nodes (first {missing[0][0]})"
+        )
+    return None
 
 
 def _deep_trie_check(kv: KVStore, heights) -> list:

@@ -38,6 +38,11 @@ class EntryPrefix(enum.IntEnum):
     CONSENSUS_STATE = 0x0901
     SHRINK_STATE = 0x0A01
     SHRINK_MARK = 0x0A02
+    # fast-sync frontier spill: discovered-but-not-yet-fetched trie-node
+    # hashes parked in the KV so the in-memory BFS frontier stays bounded
+    # on 100k+-node tries. Transient: deleted on sync completion; leftover
+    # rows after a mid-sync crash are repairable garbage (fsck prunes them)
+    FASTSYNC_FRONTIER = 0x0B01
 
 
 def prefixed(prefix: EntryPrefix, key: bytes = b"") -> bytes:
@@ -62,6 +67,31 @@ class KVStore:
 
     def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
         raise NotImplementedError
+
+    def scan_from(
+        self, prefix: bytes, after: bytes, limit: int
+    ) -> List[Tuple[bytes, bytes]]:
+        """First `limit` rows under `prefix` whose key suffix is strictly
+        greater than `after` — the cursor primitive for paged pulls
+        (fast-sync snapshot shipping). `after=b""` starts at the front."""
+        out: List[Tuple[bytes, bytes]] = []
+        floor = prefix + after
+        for k, v in self.scan_prefix(prefix):
+            if after and k <= floor:
+                continue
+            out.append((k, v))
+            if len(out) >= limit:
+                break
+        return out
+
+    def ingest(
+        self, puts: List[Tuple[bytes, bytes]], chunk: int = 2000
+    ) -> None:
+        """Bulk-load helper for import paths (snapshot shipping, db
+        import): atomic batches of `chunk`, engine hooks may follow up
+        (the LSM engine flushes its memtable after a large ingest)."""
+        for i in range(0, len(puts), chunk):
+            self.write_batch(puts[i : i + chunk])
 
     def close(self) -> None:
         pass
@@ -181,6 +211,21 @@ class SqliteKV(KVStore):
         for k, v in rows:
             if bytes(k).startswith(prefix):
                 yield bytes(k), bytes(v)
+
+    def scan_from(self, prefix: bytes, after: bytes, limit: int):
+        # indexed range scan: a snapshot page costs O(page), not O(keyspace)
+        hi = prefix + b"\xff" * 8
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k > ? AND k <= ? ORDER BY k "
+                "LIMIT ?",
+                (prefix + after, hi, limit),
+            ).fetchall()
+        return [
+            (bytes(k), bytes(v))
+            for k, v in rows
+            if bytes(k).startswith(prefix)
+        ]
 
     def close(self) -> None:
         with self._lock:

@@ -376,6 +376,17 @@ class LsmKV(KVStore):
             if self._lib.lsm_flush(self._h) != 0:
                 raise IOError("LSM flush failed")
 
+    def ingest(
+        self, puts: List[Tuple[bytes, bytes]], chunk: int = 2000
+    ) -> None:
+        """Bulk-load (snapshot shipping / db import): batched writes, then
+        seal the memtable so the imported keyspace is durable sorted
+        tables — the verification read pass that follows (root walk,
+        fsck) hits bloom-filtered SSTs instead of a giant memtable."""
+        super().ingest(puts, chunk)
+        if puts:
+            self.flush()
+
     def compact(self) -> None:
         """Flush, then run one full merge to a single table (CLI/db verb)."""
         with self._lock:

@@ -200,6 +200,18 @@ def gauge_value(name: str, labels: Optional[dict] = None) -> Optional[float]:
         return _gauges.get((name, _label_key(labels)))
 
 
+def counters_with_prefix(prefix: str) -> Dict[Tuple[str, tuple], float]:
+    """All counter series whose name starts with `prefix`, keyed by
+    (name, label_items). The scrape-free way to read a labeled family —
+    e.g. the fast-sync peer scoreboard (fastsync_peer_*{peer=...}) from
+    tests, the console, or a runbook one-liner."""
+    with _lock:
+        return {
+            key: v for key, v in _counters.items()
+            if key[0].startswith(prefix)
+        }
+
+
 # LSM read-path gauges published by storage/lsm.py (LsmKV.publish_metrics):
 #   lsm_bloom_hits       lookups a table's bloom filter ruled out (the block
 #                        fetch the filter saved)

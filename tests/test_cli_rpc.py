@@ -336,10 +336,32 @@ def test_db_verbs_and_fsck_over_lsm_engine(tmp_path):
             },
             f,
         )
-    rc, out = run(["db", "import", "--config", sq_cfg, "--dump", dump])
-    assert rc == 0 and _json.loads(out)["imported"] > 0
-    # refuses to import over an existing store
+    # the dump is never trusted blindly: without --expect-root a non-empty
+    # import is refused (and the refused store removed for a clean re-run)
     rc, _ = run(["db", "import", "--config", sq_cfg, "--dump", dump])
+    assert rc == 1
+    assert not os.path.exists(sq_db)
+    # a wrong expectation is refused the same way
+    rc, _ = run(
+        ["db", "import", "--config", sq_cfg, "--dump", dump,
+         "--expect-root", "11" * 32]
+    )
+    assert rc == 1
+    assert not os.path.exists(sq_db)
+    src = LsmKV(db_path)
+    expect = StateManager(src).committed.state_hash().hex()
+    src.close()
+    rc, out = run(
+        ["db", "import", "--config", sq_cfg, "--dump", dump,
+         "--expect-root", expect]
+    )
+    assert rc == 0 and _json.loads(out)["imported"] > 0
+    assert _json.loads(out)["verifiedRoot"] == expect
+    # refuses to import over an existing store
+    rc, _ = run(
+        ["db", "import", "--config", sq_cfg, "--dump", dump,
+         "--expect-root", expect]
+    )
     assert rc == 1
 
     src, dst = LsmKV(db_path), SqliteKV(sq_db)

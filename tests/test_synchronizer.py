@@ -17,6 +17,8 @@ from lachain_tpu.core.synchronizer import verify_block_multisig
 from lachain_tpu.core.types import MultiSig, Transaction, sign_transaction
 from lachain_tpu.crypto import ecdsa
 
+pytestmark = pytest.mark.sync
+
 CHAIN = 225
 
 
