@@ -8,7 +8,7 @@ PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-storage \
-	test-observability test-sync native bench bench-gate
+	test-observability test-sync test-pipeline native bench bench-gate
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -38,6 +38,14 @@ test-storage:
 test-observability:
 	$(PYTEST) $(PYTEST_ARGS) -m observability
 
+# consensus era pipelining: the windowed scheduler (on-vs-off block-hash
+# identity, two-run bit-identity under seeded faults), journal GC across
+# the overlap window, crash-replay of in-flight eras, stall reporting.
+# The slice to run after touching the pipeline driver (native_rt.py
+# pipeline_*/run_front/run_tail, devnet._run_eras_pipelined, era.py GC)
+test-pipeline:
+	$(PYTEST) $(PYTEST_ARGS) -m pipeline
+
 # synchronization: the multi-peer fast-sync scheduler (failover, request
 # ids, bounded frontier, bans, snapshot shipping) + the block
 # synchronizer. The slice to run after touching core/fast_sync.py,
@@ -58,8 +66,16 @@ bench:
 	python bench.py
 	python benchmarks/bench_consensus_sim.py --n 64 --eras 2
 
-# perf-regression gate: re-run the headline bench and diff it against the
-# checked-in baseline with noise-derived thresholds (exit 1 = regression)
+# perf-regression gate: re-run the headline benches and diff them against
+# the checked-in baselines with noise-derived thresholds (exit 1 =
+# regression). The consensus-sim leg runs a small PIPELINED devnet and
+# compares per-era walls too (era_phase_report_s), so a single-era
+# regression cannot hide inside the batch mean; its threshold floor is
+# wider because in-process CPU era walls are noisy.
 bench-gate:
 	python bench.py | tail -n 1 > /tmp/lachain_bench_now.json
 	python benchmarks/compare.py BENCH_r05.json /tmp/lachain_bench_now.json
+	python benchmarks/bench_consensus_sim.py --n 16 --eras 3 --txs 200 \
+		--pipeline-window 1 | tail -n 1 > /tmp/lachain_sim_now.json
+	python benchmarks/compare.py benchmarks/BENCH_sim_gate.json \
+		/tmp/lachain_sim_now.json --min-threshold-pct 40

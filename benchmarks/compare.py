@@ -128,6 +128,27 @@ def compare(base: dict, cur: dict, floor: float) -> Tuple[int, str]:
             f"(allowed {allowed:.1f}%) "
             f"{'REGRESSION' if regressed else 'ok'}"
         )
+    # per-era flight-recorder walls (bench_consensus_sim
+    # era_phase_report_s), era-by-era where both runs report the era:
+    # catches a regression hiding in one era of a pipelined batch that
+    # the batch-mean headline would smear away
+    bper = base.get("era_phase_report_s") or {}
+    cper = cur.get("era_phase_report_s") or {}
+    for era in sorted(set(bper) & set(cper), key=str):
+        try:
+            bv = float(bper[era]["wall_s"])
+            cv = float(cper[era]["wall_s"])
+        except (TypeError, ValueError, KeyError):
+            continue
+        field = f"era[{era}].wall_s"
+        regressed, delta = check_field(field, bv, cv, False, allowed)
+        failed = failed or regressed
+        rows.append(
+            f"  {field:<32} {bv:>12.4f} -> {cv:>12.4f}  "
+            f"{delta:+7.1f}% worse "
+            f"(allowed {allowed:.1f}%) "
+            f"{'REGRESSION' if regressed else 'ok'}"
+        )
     verdict = "REGRESSION" if failed else "PASS"
     header = (
         f"{verdict}: {base['metric']} vs baseline "

@@ -210,6 +210,7 @@ def _build_node(cfg, config_path=None):
         txs_per_block=cfg.blockchain.target_txs_per_block,
         wallet=wallet,
         block_interval=cfg.blockchain.target_block_time_ms / 1000.0,
+        pipeline_window=cfg.blockchain.pipeline_window,
     )
     peers = []
     for spec in cfg.network.peers:

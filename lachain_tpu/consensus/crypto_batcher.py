@@ -57,8 +57,12 @@ class TpkeEraBatcher:
 
     def __init__(self, max_slots_per_call: int = 512):
         self.max_slots_per_call = max_slots_per_call
-        self._pending: List[Tuple[Sequence, Sequence, Callable]] = []
-        self._lazy: List[Callable] = []
+        # submissions carry an era tag (None = untagged): the pipelined
+        # window flushes era-selectively because a lazy builder rt_posts
+        # into ITS era's engine — only the thread that owns that engine may
+        # resolve that era's builders
+        self._pending: List[Tuple[Sequence, Sequence, Callable, Optional[int]]] = []
+        self._lazy: List[Tuple[Callable, Optional[int]]] = []
         self.flushes = 0
         self.slots_flushed = 0
 
@@ -66,31 +70,53 @@ class TpkeEraBatcher:
     def pending(self) -> int:
         return len(self._pending) + len(self._lazy)
 
-    def submit(self, jobs: Sequence, verification_keys, callback) -> None:
+    def pending_for(self, era: Optional[int]) -> int:
+        """Pending submissions a flush(era) would cover (None counts all)."""
+        if era is None:
+            return self.pending
+        return sum(
+            1 for (_j, _v, _c, e) in self._pending if e is None or e == era
+        ) + sum(1 for (_b, e) in self._lazy if e is None or e == era)
+
+    def submit(
+        self, jobs: Sequence, verification_keys, callback, era: Optional[int] = None
+    ) -> None:
         """Queue `jobs` for the next flush; `callback(results)` receives the
         per-job (ok, combined) list, in submission order."""
         if jobs:
-            self._pending.append((jobs, verification_keys, callback))
+            self._pending.append((jobs, verification_keys, callback, era))
 
-    def submit_lazy(self, build) -> None:
+    def submit_lazy(self, build, era: Optional[int] = None) -> None:
         """Queue a job BUILDER resolved at flush time: `build()` returns
         (jobs, verification_keys, callback) or None. Lazy submission lets a
         protocol note once that it has ready work and do the expensive
         per-slot preparation (share parsing, Lagrange rows) exactly once per
         flush, covering everything that became ready in the meantime."""
-        self._lazy.append(build)
+        self._lazy.append((build, era))
 
-    def flush(self) -> int:
-        """Run all pending jobs through the backend era call; returns the
-        number of submissions completed. Callbacks run inside flush and may
-        re-submit (their work joins the NEXT flush)."""
+    def flush(self, era: Optional[int] = None) -> int:
+        """Run pending jobs through the backend era call; returns the number
+        of submissions completed. `era` selects one era's submissions
+        (untagged ones always join); None flushes everything. Callbacks run
+        inside flush and may re-submit (their work joins the NEXT flush)."""
         if not self._pending and not self._lazy:
             return 0
         from ..crypto.provider import get_backend
 
-        batch, self._pending = self._pending, []
-        lazy, self._lazy = self._lazy, []
-        for build in lazy:
+        if era is None:
+            taken, self._pending = self._pending, []
+            lazy_taken, self._lazy = self._lazy, []
+        else:
+            taken, keep = [], []
+            for s in self._pending:
+                (taken if s[3] is None or s[3] == era else keep).append(s)
+            self._pending = keep
+            lazy_taken, lazy_keep = [], []
+            for s in self._lazy:
+                (lazy_taken if s[1] is None or s[1] == era else lazy_keep).append(s)
+            self._lazy = lazy_keep
+        batch = [(jobs, vks, cb) for (jobs, vks, cb, _e) in taken]
+        for build, _e in lazy_taken:
             item = build()
             if item is not None:
                 batch.append(item)

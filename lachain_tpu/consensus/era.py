@@ -80,6 +80,15 @@ class EraRouter(Broadcaster):
         # with the protocol GC.
         self._journal = journal
         self._sent_slots: Dict[Tuple[int, tuple], bytes] = {}
+        # pipelined-era window: `era` is the FRONT (newest open) era and
+        # `window_floor` the oldest era still in flight (uncommitted).
+        # Sequential operation keeps the two equal (advance_era moves both);
+        # the pipelined scheduler moves them independently via
+        # open_era / commit_era_gc. `pipeline_window` is the configured
+        # lookahead; it widens the GC retention so an era's journal and
+        # outbox survive until every era that overlapped it has committed.
+        self.pipeline_window = 0
+        self.window_floor = era
 
     # -- Broadcaster interface ----------------------------------------------
     @property
@@ -160,9 +169,14 @@ class EraRouter(Broadcaster):
 
     # -- retransmission outbox ------------------------------------------------
     def _record_outbox(self, target: Optional[int], payload) -> None:
-        q = self._outbox.get(self.era)
+        # key by the PAYLOAD's era, not the router's front era: with a
+        # pipeline window open, a tail era's header/coin sends happen while
+        # self.era already points one or more eras ahead, and a
+        # message_request for the tail era must find them
+        era = self._payload_era(payload)
+        q = self._outbox.get(era)
         if q is None:
-            q = self._outbox[self.era] = deque()
+            q = self._outbox[era] = deque()
         if len(q) >= self.outbox_cap:
             q.popleft()
             from ..utils import metrics
@@ -216,7 +230,9 @@ class EraRouter(Broadcaster):
             logger.warning("unroutable payload from %d", sender)
             return
         msg_era = getattr(pid, "era", None)
-        if msg_era is not None and msg_era != self.era:
+        if msg_era is not None and not (
+            self.window_floor <= msg_era <= self.era
+        ):
             if msg_era > self.era:
                 # a faster validator is already in a future era: buffer until
                 # we advance (reference postponed-message window)
@@ -242,6 +258,7 @@ class EraRouter(Broadcaster):
             return
         old_era = self.era
         self.era = new_era
+        self.window_floor = new_era
         # drop protocol instances from finished eras (reference FinishEra
         # clears its registry): laggard sub-protocols an era's outcome never
         # needed would otherwise accumulate for the node's lifetime — real
@@ -250,10 +267,38 @@ class EraRouter(Broadcaster):
         # queries (block production racing the advance, multi-era observer
         # jumps included) still resolve.
         cutoff = min(new_era - 1, old_era)
+        self._gc_below(cutoff)
+        self._replay_postponed()
+
+    def open_era(self, new_era: int) -> None:
+        """Pipelined window open: move the FRONT era forward WITHOUT
+        garbage-collecting anything. The eras in [window_floor, new_era]
+        stay live concurrently — their protocols keep dispatching, their
+        journal/outbox entries stay replayable. GC happens only at the
+        commit edge (commit_era_gc), so a crash mid-window can replay every
+        in-flight era from the journal instead of re-deriving values
+        (no-self-equivocation across the whole window)."""
+        if new_era <= self.era:
+            return
+        self.era = new_era
+        self._replay_postponed()
+
+    def commit_era_gc(self, committed_era: int) -> None:
+        """Commit-edge GC for pipelined windows: era e is pruned only once
+        every era that overlapped its window has committed — i.e. at the
+        commit of era c, eras below c - pipeline_window + 1 are settled AND
+        un-overlapped, so their journal entries, outboxes, sent-latches and
+        protocol instances can go. window_floor advances to the oldest era
+        still in flight."""
+        self.window_floor = max(self.window_floor, committed_era + 1)
+        cutoff = committed_era + 1 - max(self.pipeline_window, 1)
+        self._gc_below(cutoff)
+
+    def _gc_below(self, cutoff: int) -> None:
         stale = [
             pid
             for pid in self._protocols
-            if getattr(pid, "era", new_era) < cutoff
+            if getattr(pid, "era", cutoff) < cutoff
         ]
         for pid in stale:
             proto = self._protocols.pop(pid, None)
@@ -271,6 +316,8 @@ class EraRouter(Broadcaster):
             del self._sent_slots[key]
         if self._journal is not None:
             self._journal.prune_below(cutoff)
+
+    def _replay_postponed(self) -> None:
         pending, self._postponed = self._postponed, []
         self._postponed_per_sender = {}
         for sender, payload in pending:
@@ -285,7 +332,8 @@ class EraRouter(Broadcaster):
 
     # -- validation (EraBroadcaster.cs:418-529) -------------------------------
     def _validate_id(self, pid) -> bool:
-        if getattr(pid, "era", None) != self.era:
+        era = getattr(pid, "era", None)
+        if era is None or not (self.window_floor <= era <= self.era):
             return False
         n = self.n_validators
         if isinstance(pid, M.ReliableBroadcastId):
@@ -302,7 +350,7 @@ class EraRouter(Broadcaster):
         proto = self._protocols.get(pid)
         if proto is not None:
             return None if proto.terminated else proto
-        if getattr(pid, "era", self.era) < self.era:
+        if getattr(pid, "era", self.era) < self.window_floor:
             # a dead era's instances are garbage-collected on advance, so
             # their terminated tombstones are gone — a stale internal
             # request must not resurrect a fresh never-terminating

@@ -2049,8 +2049,16 @@ void NRoot::maybe_verify() {
 
 extern "C" {
 
-int lt_crt_version() { return 3; }
+int lt_crt_version() { return 4; }
 
+// Engines are single-threaded by contract: one engine = one queue = one
+// dispatch loop. The pipelined era window (native_rt.py) therefore runs ONE
+// ENGINE PER IN-FLIGHT ERA, each pumped by exactly one thread at a time —
+// never this engine from two threads. The only cross-thread calls the
+// binding makes are rt_request_stop (a plain bool store: worst case the
+// running engine finishes its current chunk) and the read-only aggregate
+// accessors. NOTE: construct engines on ONE thread only — the GF(256)
+// table bootstrap (gf_init) is guarded by a non-atomic static flag.
 void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
              int era0) {
   return new Engine(n, f, mode, repeat_ppm, seed, era0);
@@ -2105,11 +2113,16 @@ uint64_t rt_native_handled(void* h) {
 
 // Watchdog introspection: render one validator's native crypto-protocol
 // state so a stall report can name where a natively-owned id is stuck.
+// Under the pipelined window the binding calls this once per in-flight
+// era's engine and joins the strings era-labeled, so the report spans the
+// whole window; q/delivered give the engine-level delivery picture.
 size_t rt_debug_state(void* h, int vid, char* buf, size_t cap) {
   Engine* E = static_cast<Engine*>(h);
   Validator& V = E->vals[vid];
   std::string s = "era=" + std::to_string(V.era) +
-                  " own_mask=" + std::to_string((int)V.own_mask);
+                  " own_mask=" + std::to_string((int)V.own_mask) +
+                  " q=" + std::to_string(E->q.size()) +
+                  " delivered=" + std::to_string(E->delivered);
   if (V.nhb) {
     NHB* hb = V.nhb;
     s += " hb{slots=" + std::to_string(hb->ct_slots.size()) + "/" +

@@ -112,6 +112,7 @@ class CoinHost:
             self.cid.agreement,
             self.cid.epoch,
             wire.share,
+            era=self.cid.era,
         )
 
     def combine(self, blob: bytes) -> None:
@@ -141,6 +142,7 @@ class CoinHost:
                 self.cid.agreement,
                 self.cid.epoch,
                 bytes([1 if sig.parity else 0]),
+                era=self.cid.era,
             )
 
 
@@ -166,7 +168,9 @@ class HoneyBadgerHost:
         self.result: Optional[dict] = None
 
     def _post(self, op: int, a: int = 0, b: int = 0, data: bytes = b"") -> None:
-        self.router._net._rt_post(self.router.my_id, op, a, b, data)
+        self.router._net._rt_post(
+            self.router.my_id, op, a, b, data, era=self.id.era
+        )
 
     # -- input ---------------------------------------------------------------
     def handle_input(self, value: bytes) -> None:
@@ -213,13 +217,17 @@ class HoneyBadgerHost:
 
     # -- batcher protocol (XO_HB_QUEUE -> lazy build -> results cb) ----------
     def on_queue(self) -> None:
-        self.router.crypto_batcher.submit_lazy(self._build_era_jobs_lazy)
+        self.router.crypto_batcher.submit_lazy(
+            self._build_era_jobs_lazy, era=self.id.era
+        )
         tracing.instant("hb.queue_decrypt", cat="crypto", era=self.id.era)
 
     def _refresh_cands(self) -> List[int]:
         """Pull the engine's ready slots + candidate shares; returns the
         ready slot list (ascending, the oracle's _ready_slots order)."""
-        blob = self.router._net._rt_hb_export(self.router.my_id)
+        blob = self.router._net._rt_hb_export(
+            self.router.my_id, era=self.id.era
+        )
         ready = []
         off = 0
         end = len(blob)
@@ -457,17 +465,23 @@ class RootHost:
             0,
             0,
             len(own).to_bytes(4, "big") + own + bcast,
+            era=self.id.era,
         )
 
     # XO_ROOT_VERIFY — root_protocol.py::_on_signed_header signature checks
     def on_verify(self, blob: bytes) -> None:
         me = self.router.my_id
+        era = self.id.era
         for sender, sig in iter_pairs(blob):
             if ecdsa.verify_hash(self._pubs[sender], self._header_hash, sig):
                 self._signatures[sender] = sig
-                self.router._net._rt_post(me, PO_ROOT_ACCEPT, sender, 0, b"")
+                self.router._net._rt_post(
+                    me, PO_ROOT_ACCEPT, sender, 0, b"", era=era
+                )
             else:
-                self.router._net._rt_post(me, PO_ROOT_REJECT, sender, 0, b"")
+                self.router._net._rt_post(
+                    me, PO_ROOT_REJECT, sender, 0, b"", era=era
+                )
 
     # XO_ROOT_PRODUCE — root_protocol.py::_try_produce
     def on_produce(self):
@@ -480,5 +494,5 @@ class RootHost:
         self.router._native_results[self.id] = block
         # top-level completion: break the engine out of its chunk, exactly
         # like internal_response(to_id=None) does for Python protocols
-        self.router._net._request_stop()
+        self.router._net._request_stop(era=self.id.era)
         return block

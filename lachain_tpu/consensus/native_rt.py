@@ -125,7 +125,7 @@ def load_rt():
         )
     lib = ctypes.CDLL(_LIB_PATH)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 3
+    assert lib.lt_crt_version() == 4
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -330,6 +330,7 @@ class NativeCoinParent:
 
     agreement: int
     epoch: int
+    era: int = 0  # routes the result to the right per-era engine
 
 
 class _EraHosts:
@@ -454,23 +455,24 @@ class NativeEraRouter(EraRouter):
         to = req.to_id
         if isinstance(to, M.CommonSubsetId):
             self._acs_parent = req.from_id
-            self._net._post_acs_input(self._my_id, req.input)
+            self._net._post_acs_input(self._my_id, req.input, era=to.era)
             return
         if isinstance(
             to,
             (M.BinaryAgreementId, M.BinaryBroadcastId, M.ReliableBroadcastId),
         ):
             raise RuntimeError(f"natively-owned protocol requested: {to}")
-        if getattr(to, "era", None) == self.era:
+        to_era = getattr(to, "era", None)
+        if to_era is not None and self.window_floor <= to_era <= self.era:
             mask = self._native_mask()
             if isinstance(to, M.RootProtocolId) and (mask & OWN_ROOT):
                 self._net._sync_owner(self._my_id)
-                self._net._rt_request(self._my_id, RQ_ROOT, 0, 0)
+                self._net._rt_request(self._my_id, RQ_ROOT, 0, 0, era=to_era)
                 return
             if isinstance(to, M.HoneyBadgerId) and (mask & OWN_HB):
                 self._net._sync_owner(self._my_id)
                 self._hosts(to.era).py_parents["hb"] = req.from_id
-                self._net._rt_request(self._my_id, RQ_HB, 0, 0)
+                self._net._rt_request(self._my_id, RQ_HB, 0, 0, era=to_era)
                 if to in self._native_results:
                     return  # done-replay: the result was re-routed already
                 self.hb_host(to.era).handle_input(req.input)
@@ -481,7 +483,7 @@ class NativeEraRouter(EraRouter):
                     ("coin", to.agreement, to.epoch)
                 ] = req.from_id
                 self._net._rt_request(
-                    self._my_id, RQ_COIN, to.agreement, to.epoch
+                    self._my_id, RQ_COIN, to.agreement, to.epoch, era=to_era
                 )
                 return
         super().internal_request(req)
@@ -489,7 +491,11 @@ class NativeEraRouter(EraRouter):
     def internal_response(self, res: M.Result) -> None:
         if isinstance(res.to_id, NativeCoinParent):
             self._net._post_coin_result(
-                self._my_id, res.to_id.agreement, res.to_id.epoch, res.value
+                self._my_id,
+                res.to_id.agreement,
+                res.to_id.epoch,
+                res.value,
+                era=res.to_id.era,
             )
             return
         if res.to_id is None:
@@ -497,7 +503,7 @@ class NativeEraRouter(EraRouter):
             # break the engine out of its chunk so the driver can re-check
             # done() promptly — mirrors the Python simulator's per-message
             # done() check and keeps lag-round coin work off the hot path
-            self._net._request_stop()
+            self._net._request_stop(era=getattr(res.from_id, "era", None))
             return
         super().internal_response(res)
 
@@ -512,7 +518,12 @@ class NativeEraRouter(EraRouter):
         transport half of broadcast — no journaling, no outbox record)."""
         if isinstance(payload, M.DecryptedMessage):
             self._net._bcast_opaque(
-                self._my_id, KIND_DECRYPTED, payload.share_id, 0, payload.payload
+                self._my_id,
+                KIND_DECRYPTED,
+                payload.share_id,
+                0,
+                payload.payload,
+                era=payload.hb.era,
             )
         elif isinstance(payload, M.SignedHeaderMessage):
             data = (
@@ -520,7 +531,9 @@ class NativeEraRouter(EraRouter):
                 + payload.header_bytes
                 + payload.signature
             )
-            self._net._bcast_opaque(self._my_id, KIND_SIGNED_HEADER, 0, 0, data)
+            self._net._bcast_opaque(
+                self._my_id, KIND_SIGNED_HEADER, 0, 0, data, era=payload.root.era
+            )
         elif isinstance(payload, M.CoinMessage):
             self._net._bcast_opaque(
                 self._my_id,
@@ -528,6 +541,7 @@ class NativeEraRouter(EraRouter):
                 payload.coin.agreement,
                 payload.coin.epoch,
                 payload.share,
+                era=payload.coin.era,
             )
         else:
             raise TypeError(f"unexpected python-protocol payload {type(payload)}")
@@ -539,7 +553,7 @@ class NativeEraRouter(EraRouter):
         answered with a re-broadcast of the recorded payloads. The engine
         runs the router's current era only; older eras' flood traffic is
         engine-internal and already superseded by the decided block."""
-        if era != self.era:
+        if not (self.window_floor <= era <= self.era):
             return 0
         payloads = self.outbox_payloads(era, requester)
         for payload in payloads:
@@ -598,15 +612,24 @@ class NativeEraRouter(EraRouter):
         # host shims and native results follow the same retention as
         # protocol instances: keep the last active era, drop older
         cutoff = min(new_era - 1, old_era)
+        self._prune_native_state(cutoff)
+        self._net._advance_era(self._my_id, new_era)
+
+    def commit_era_gc(self, committed_era: int) -> None:
+        super().commit_era_gc(committed_era)
+        self._prune_native_state(
+            committed_era + 1 - max(self.pipeline_window, 1)
+        )
+
+    def _prune_native_state(self, cutoff: int) -> None:
         for e in [e for e in self._era_hosts if e < cutoff]:
             del self._era_hosts[e]
         for pid in [
             p
             for p in self._native_results
-            if getattr(p, "era", new_era) < cutoff
+            if getattr(p, "era", cutoff) < cutoff
         ]:
             del self._native_results[pid]
-        self._net._advance_era(self._my_id, new_era)
 
     # -- engine callbacks (legacy per-message path) ----------------------------
     def _on_opaque(
@@ -645,7 +668,9 @@ class NativeEraRouter(EraRouter):
         cid = M.CoinId(era=era, agreement=agreement, epoch=epoch)
         super().internal_request(
             M.Request(
-                from_id=NativeCoinParent(agreement=agreement, epoch=epoch),
+                from_id=NativeCoinParent(
+                    agreement=agreement, epoch=epoch, era=era
+                ),
                 to_id=cid,
                 input=None,
             )
@@ -688,6 +713,11 @@ class NativeEraRouter(EraRouter):
         elif op == XO_ROOT_INPUT:
             self.root_host(era).on_input()
         elif op == XO_ROOT_SIGN:
+            # pipelined window: the sign point is the front/tail boundary —
+            # the scheduler stashes the coin parity here and resumes the
+            # sign on the tail lane once the parent block has committed
+            if self._net._defer_sign(self._my_id, era, a):
+                return
             self.root_host(era).on_sign(a)
         elif op == XO_ROOT_VERIFY:
             self.root_host(era).on_verify(blob)
@@ -713,6 +743,7 @@ class NativeSimulatedNetwork:
         use_crypto_batcher: bool = True,
         fault_plan=None,
         journals: Optional[List] = None,
+        pipeline_window: int = 0,
     ):
         self.n = public_keys.n
         self.muted = set(muted or set())
@@ -756,19 +787,39 @@ class NativeSimulatedNetwork:
             DeliveryMode.TAKE_LAST: 1,
             DeliveryMode.TAKE_RANDOM: 2,
         }[mode]
+        # engine-construction parameters are kept so the pipelined window
+        # can instantiate ONE ENGINE PER IN-FLIGHT ERA: an engine has one
+        # queue and one dispatch loop, so wall-clock overlap of era e's tail
+        # with era e+1's front requires two independently pumpable engines.
+        # Per-era engines also keep determinism trivial — each era's engine
+        # sees exactly the event sequence a sequential run would feed it.
+        self.f = public_keys.f
+        self._mode_i = mode_i
+        self._repeat_ppm = int(repeat_probability * 1_000_000)
+        self._base_seed = seed & 0xFFFFFFFFFFFFFFFF
+        self._coin_need = public_keys.ts_keys.t + 1
+        self.pipeline_window = max(int(pipeline_window), 0)
+        self._pipeline_active = False
+        self._deferred: Dict[int, Dict[int, int]] = {}
+        self._era_engines: Dict[int, int] = {}
+        self._native_handled_closed = 0
+        self._trace_dropped_closed = 0
+        self._trace_backlog: List[dict] = []
+        self._trace_capacity = 0
         self._h = self._lib.rt_new(
             self.n,
             public_keys.f,
             mode_i,
-            int(repeat_probability * 1_000_000),
+            self._repeat_ppm,
             seed,
             era,
         )
+        self._era_engines[era] = self._h
         for v in self.muted:
             self._lib.rt_mute(self._h, v)
         # threshold for the native coin's combine trigger (CommonCoin needs
         # t+1 shares before a combine can possibly succeed)
-        self._lib.rt_set_coin_need(self._h, public_keys.ts_keys.t + 1)
+        self._lib.rt_set_coin_need(self._h, self._coin_need)
         self.routers: List[NativeEraRouter] = [
             NativeEraRouter(
                 era=era,
@@ -781,8 +832,14 @@ class NativeSimulatedNetwork:
             )
             for i in range(self.n)
         ]
-        self._cb_error: Optional[BaseException] = None
-        # keep CFUNCTYPE objects alive for the engine's lifetime
+        for r in self.routers:
+            r.pipeline_window = self.pipeline_window
+        # callback exceptions, stashed per era and re-raised from the pump
+        # loop of the thread that owns that era's engine
+        self._cb_errors: List[tuple] = []
+        # keep CFUNCTYPE objects alive for the engine's lifetime; every
+        # per-era engine shares the same set — callbacks carry the era, which
+        # routes them to the right per-era host shims
         self._cbs = (
             _OPAQUE_CB(self._cb_opaque),
             _ACS_CB(self._cb_acs),
@@ -819,39 +876,107 @@ class NativeSimulatedNetwork:
             ),
         )
 
+    # -- per-era engine lifecycle ---------------------------------------------
+    def _live_engines(self) -> List[int]:
+        hs: List[int] = []
+        if self._h is not None:
+            hs.append(self._h)
+        for h in self._era_engines.values():
+            if h not in hs:
+                hs.append(h)
+        return hs
+
+    def _h_for(self, era: Optional[int]) -> Optional[int]:
+        """Engine handle for `era`: the per-era engine when the pipeline
+        window is active, the single shared engine otherwise. None means the
+        era's engine is already closed — its traffic is settled and posts
+        for it are dropped, mirroring the stale-era drop."""
+        if self._pipeline_active and era is not None:
+            return self._era_engines.get(era)
+        return self._h
+
+    def _era_seed(self, era: int) -> int:
+        # deterministic per-era engine seed: two runs with the same base
+        # seed get byte-identical delivery schedules era by era
+        return (self._base_seed ^ (era * 0x9E3779B97F4A7C15)) & (
+            (1 << 64) - 1
+        )
+
+    def _open_era_engine(self, era: int) -> None:
+        if era in self._era_engines:
+            return
+        # engines are constructed on the scheduler thread only: the GF(256)
+        # table bootstrap in consensus_rt.cpp is guarded by a plain static
+        # flag, so first-construction must never race across threads
+        h = self._lib.rt_new(
+            self.n, self.f, self._mode_i, self._repeat_ppm,
+            self._era_seed(era), era,
+        )
+        for v in self.muted:
+            self._lib.rt_mute(h, v)
+        self._lib.rt_set_coin_need(h, self._coin_need)
+        self._lib.rt_set_callbacks(h, *self._cbs)
+        for vid in range(self.n):
+            if self._own_masks[vid] >= 0:
+                self._lib.rt_set_owned(h, vid, self._own_masks[vid])
+        self._lib.rt_trace_configure(h, max(int(self._trace_capacity), 0))
+        self._era_engines[era] = h
+
+    def _close_era_engine(self, era: int) -> None:
+        h = self._era_engines.pop(era, None)
+        if h is None or h == self._h:
+            # the construction-time engine doubles as the legacy single-era
+            # handle; keep it alive (quiescent) for the aggregate accessors
+            return
+        try:
+            self._trace_backlog.extend(self._drain_engine_trace(h))
+        except Exception:  # pragma: no cover - tracing must never kill an era
+            pass
+        self._native_handled_closed += int(self._lib.rt_native_handled(h))
+        self._trace_dropped_closed += int(self._lib.rt_trace_dropped(h))
+        self._lib.rt_free(h)
+
     # -- flight recorder -------------------------------------------------------
     def trace_configure(self, capacity: int) -> None:
-        """Resize the engine-side trace ring; 0 disables recording (and
+        """Resize the engine-side trace rings; 0 disables recording (and
         the hot-path clock reads) entirely — the bench overhead check."""
-        if self._h is not None:
-            self._lib.rt_trace_configure(self._h, max(int(capacity), 0))
+        self._trace_capacity = max(int(capacity), 0)
+        for h in self._live_engines():
+            self._lib.rt_trace_configure(h, self._trace_capacity)
 
     def trace_dropped(self) -> int:
-        if self._h is None:
-            return self._trace_dropped_seen
-        return int(self._lib.rt_trace_dropped(self._h))
+        total = self._trace_dropped_closed
+        for h in self._live_engines():
+            total += int(self._lib.rt_trace_dropped(h))
+        return total
 
-    def _drain_trace(self) -> List[dict]:
-        """Consume the engine ring -> merged-tracer event dicts. Publishes
-        native drop-counter growth as a counter delta so
-        trace_events_dropped_total keeps counter semantics."""
-        if self._h is None:
-            return []
-        evs: List[dict] = []
+    def _drain_engine_trace(self, h: int) -> List[dict]:
         # size query, then copying call; the copy consumes the ring. Slack
         # covers records appended between the two calls; if the ring still
         # outgrew the buffer (got > len(buf) means no copy happened), retry.
         for _ in range(4):
-            need = self._lib.rt_trace_drain(self._h, None, 0)
+            need = self._lib.rt_trace_drain(h, None, 0)
             if need == 0:
-                break
+                return []
             buf = (ctypes.c_uint8 * (need + 4096))()
-            got = self._lib.rt_trace_drain(self._h, buf, len(buf))
+            got = self._lib.rt_trace_drain(h, buf, len(buf))
             if got <= len(buf):
-                evs = decode_consensus_trace(
+                return decode_consensus_trace(
                     bytes(buf[:got]), self._trace_offset, self._trace_source
                 )
-                break
+        return []
+
+    def _drain_trace(self) -> List[dict]:
+        """Consume the engine rings -> merged-tracer event dicts. Publishes
+        native drop-counter growth as a counter delta so
+        trace_events_dropped_total keeps counter semantics. While the
+        pipeline window is live, only the backlog of CLOSED era engines is
+        served: draining a ring that another thread is appending to would
+        race inside the engine, so live rings wait for pipeline_end."""
+        evs, self._trace_backlog = self._trace_backlog, []
+        if not self._pipeline_active:
+            for h in self._live_engines():
+                evs.extend(self._drain_engine_trace(h))
         dropped = self.trace_dropped()
         if dropped > self._trace_dropped_seen:
             metrics.inc(
@@ -863,15 +988,18 @@ class NativeSimulatedNetwork:
         return evs
 
     def close(self) -> None:
-        if self._h is not None:
+        if self._h is not None or self._era_engines:
             # pull any still-buffered engine events into the merged tracer
-            # before the ring is freed
+            # before the rings are freed
+            self._pipeline_active = False
             try:
                 tracing.drain_native()
             except Exception:
                 pass
             tracing.unregister_native_source(self._trace_source)
-            self._lib.rt_free(self._h)
+            for h in self._live_engines():
+                self._lib.rt_free(h)
+            self._era_engines = {}
             self._h = None
 
     def __del__(self):  # pragma: no cover
@@ -892,7 +1020,8 @@ class NativeSimulatedNetwork:
         mask = self.routers[vid]._native_mask()
         if mask != self._own_masks[vid]:
             self._own_masks[vid] = mask
-            self._lib.rt_set_owned(self._h, vid, mask)
+            for h in self._live_engines():
+                self._lib.rt_set_owned(h, vid, mask)
 
     def _sync_ownership(self) -> None:
         for vid in range(self.n):
@@ -905,68 +1034,132 @@ class NativeSimulatedNetwork:
         self._sync_owner(vid)
 
     # -- engine entry points ---------------------------------------------------
-    def _post_acs_input(self, vid: int, data: bytes) -> None:
-        self._lib.rt_post_acs_input(self._h, vid, data, len(data))
+    # Each takes era=None and routes to that era's engine via _h_for. A None
+    # handle means the era's engine already closed (its block committed and
+    # settled traffic is still draining through host shims) — the post is
+    # dropped, exactly like the router's stale-era drop.
+    def _post_acs_input(self, vid: int, data: bytes, era: int = None) -> None:
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_post_acs_input(h, vid, data, len(data))
 
-    def _post_coin_result(self, vid: int, agreement: int, epoch: int, value) -> None:
-        self._lib.rt_post_coin_result(
-            self._h, vid, agreement, epoch, 1 if value else 0
-        )
+    def _post_coin_result(
+        self, vid: int, agreement: int, epoch: int, value, era: int = None
+    ) -> None:
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_post_coin_result(
+                h, vid, agreement, epoch, 1 if value else 0
+            )
 
     def _bcast_opaque(
-        self, vid: int, kind: int, agreement: int, epoch: int, data: bytes
+        self,
+        vid: int,
+        kind: int,
+        agreement: int,
+        epoch: int,
+        data: bytes,
+        era: int = None,
     ) -> None:
-        self._lib.rt_broadcast_opaque(
-            self._h, vid, kind, agreement, epoch, data, len(data)
-        )
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_broadcast_opaque(
+                h, vid, kind, agreement, epoch, data, len(data)
+            )
 
-    def _rt_request(self, vid: int, kind: int, a: int, b: int) -> None:
-        self._lib.rt_request(self._h, vid, kind, a, b)
-        err = self._cb_error
-        if err is not None:
-            # a request posted OUTSIDE run() (post_request path) can recurse
-            # through the engine into host code; surface its failure now
-            self._cb_error = None
-            raise err
+    def _rt_request(self, vid: int, kind: int, a: int, b: int, era: int = None) -> None:
+        h = self._h_for(era)
+        if h is None:
+            return
+        self._lib.rt_request(h, vid, kind, a, b)
+        # a request posted OUTSIDE run() (post_request path) can recurse
+        # through the engine into host code; surface its failure now
+        self._raise_cb_error(era)
 
-    def _rt_post(self, vid: int, op: int, a: int, b: int, data: bytes = b"") -> None:
-        self._lib.rt_post(self._h, vid, op, a, b, data, len(data))
+    def _rt_post(
+        self, vid: int, op: int, a: int, b: int, data: bytes = b"", era: int = None
+    ) -> None:
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_post(h, vid, op, a, b, data, len(data))
 
-    def _rt_hb_export(self, vid: int) -> bytes:
-        size = self._lib.rt_hb_ready_export(self._h, vid, None, 0)
+    def _rt_hb_export(self, vid: int, era: int = None) -> bytes:
+        h = self._h_for(era)
+        if h is None:
+            return b""
+        size = self._lib.rt_hb_ready_export(h, vid, None, 0)
         if not size:
             return b""
         buf = ctypes.create_string_buffer(size)
-        self._lib.rt_hb_ready_export(self._h, vid, buf, size)
+        self._lib.rt_hb_ready_export(h, vid, buf, size)
         return buf.raw[:size]
 
-    def native_state_of(self, vid: int) -> str:
-        size = self._lib.rt_debug_state(self._h, vid, None, 0)
-        if not size:
-            return ""
-        buf = ctypes.create_string_buffer(size)
-        self._lib.rt_debug_state(self._h, vid, buf, size)
-        return buf.raw[:size].decode("utf-8", "replace")
+    def native_state_of(self, vid: int, era: int = None) -> str:
+        def one(h):
+            size = self._lib.rt_debug_state(h, vid, None, 0)
+            if not size:
+                return ""
+            buf = ctypes.create_string_buffer(size)
+            self._lib.rt_debug_state(h, vid, buf, size)
+            return buf.raw[:size].decode("utf-8", "replace")
+
+        if self._pipeline_active and era is None:
+            # stall reports want the whole window, labeled per era
+            parts = [
+                f"era{e}:{one(h)}"
+                for e, h in sorted(self._era_engines.items())
+            ]
+            return " | ".join(parts)
+        h = self._h_for(era)
+        return one(h) if h is not None else ""
 
     def native_handled(self) -> int:
         """Messages the engine consumed natively that PREVIOUSLY each cost a
         per-message Python callback — the eliminated crossings."""
-        return int(self._lib.rt_native_handled(self._h))
+        total = self._native_handled_closed
+        for h in self._live_engines():
+            total += int(self._lib.rt_native_handled(h))
+        return total
 
     def _advance_era(self, vid: int, era: int) -> None:
         self._lib.rt_advance_era(self._h, vid, era)
 
-    def _request_stop(self) -> None:
-        self._lib.rt_request_stop(self._h)
+    def _request_stop(self, era: int = None) -> None:
+        if self._pipeline_active and era is None:
+            for h in self._live_engines():
+                self._lib.rt_request_stop(h)
+            return
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_request_stop(h)
 
     def mute(self, vid: int) -> None:
         self.muted.add(vid)
-        self._lib.rt_mute(self._h, vid)
+        for h in self._live_engines():
+            self._lib.rt_mute(h, vid)
 
-    # -- callbacks (engine -> Python); exceptions are stashed and re-raised
-    #    from run(), since they cannot unwind through the C++ frames ----------
+    # -- callbacks (engine -> Python); exceptions are stashed per era and
+    #    re-raised from the pump loop of the thread owning that era's engine,
+    #    since they cannot unwind through the C++ frames ----------------------
+    def _stash_cb_error(self, era, exc) -> None:
+        self._cb_errors.append((era, exc))
+
+    def _pop_cb_error(self, era=None) -> Optional[BaseException]:
+        """Take the first stashed error for `era` (None matches any — the
+        sequential path, where one thread owns every engine)."""
+        for i, (e, exc) in enumerate(self._cb_errors):
+            if era is None or e == era or e is None:
+                del self._cb_errors[i]
+                return exc
+        return None
+
+    def _raise_cb_error(self, era=None) -> None:
+        err = self._pop_cb_error(era)
+        if err is not None:
+            raise err
+
     def _cb_opaque(self, target, sender, era, kind, agreement, epoch, data, length):
-        if self._cb_error is not None:
+        if self._cb_errors:
             return
         try:
             metrics.inc(CROSSINGS_METRIC, labels={"op": "opaque_message"})
@@ -974,20 +1167,22 @@ class NativeSimulatedNetwork:
             self.routers[target]._on_opaque(
                 sender, era, kind, agreement, epoch, blob
             )
-            if (
-                kind == KIND_DECRYPTED
-                and self.crypto_batcher is not None
-                and self.crypto_batcher.pending
-                and self._lib.rt_opaque_pending(self._h, KIND_DECRYPTED) == 0
-            ):
-                # all decryption shares delivered: break out so run() can
-                # flush the cross-validator batch before lag-round traffic
-                self._lib.rt_request_stop(self._h)
+            if kind == KIND_DECRYPTED and self.crypto_batcher is not None:
+                h = self._h_for(era)
+                if (
+                    h is not None
+                    and self.crypto_batcher.pending_for(era)
+                    and self._lib.rt_opaque_pending(h, KIND_DECRYPTED) == 0
+                ):
+                    # all decryption shares delivered: break out so the pump
+                    # loop can flush the cross-validator batch before
+                    # lag-round traffic
+                    self._lib.rt_request_stop(h)
         except BaseException as exc:  # noqa: BLE001
-            self._cb_error = exc
+            self._stash_cb_error(era, exc)
 
     def _cb_acs(self, target, era, nslots, slots, datas, lens):
-        if self._cb_error is not None:
+        if self._cb_errors:
             return
         try:
             metrics.inc(CROSSINGS_METRIC, labels={"op": "acs_result"})
@@ -999,19 +1194,19 @@ class NativeSimulatedNetwork:
             }
             self.routers[target]._on_acs_result(era, result)
         except BaseException as exc:  # noqa: BLE001
-            self._cb_error = exc
+            self._stash_cb_error(era, exc)
 
     def _cb_coinreq(self, target, era, agreement, epoch):
-        if self._cb_error is not None:
+        if self._cb_errors:
             return
         try:
             metrics.inc(CROSSINGS_METRIC, labels={"op": "coin_request"})
             self.routers[target]._on_coin_request(era, agreement, epoch)
         except BaseException as exc:  # noqa: BLE001
-            self._cb_error = exc
+            self._stash_cb_error(era, exc)
 
     def _cb_cross(self, target, era, op, a, b, data, length):
-        if self._cb_error is not None:
+        if self._cb_errors:
             return
         try:
             metrics.inc(
@@ -1021,7 +1216,7 @@ class NativeSimulatedNetwork:
             blob = ctypes.string_at(data, length) if length else b""
             self.routers[target]._on_cross(era, op, a, b, blob)
         except BaseException as exc:  # noqa: BLE001
-            self._cb_error = exc
+            self._stash_cb_error(era, exc)
 
     # -- execution (simulator.py::run contract) --------------------------------
     def post_request(self, validator: int, pid, value) -> None:
@@ -1040,9 +1235,7 @@ class NativeSimulatedNetwork:
             while not done():
                 processed = self._lib.rt_run(self._h, chunk)
                 self.delivered_count += processed
-                if self._cb_error is not None:
-                    err, self._cb_error = self._cb_error, None
-                    raise err
+                self._raise_cb_error()
                 if (
                     self.crypto_batcher is not None
                     and self.crypto_batcher.pending
@@ -1053,9 +1246,7 @@ class NativeSimulatedNetwork:
                     )
                 ):
                     self.crypto_batcher.flush()
-                    if self._cb_error is not None:
-                        err, self._cb_error = self._cb_error, None
-                        raise err
+                    self._raise_cb_error()
                     continue
                 if processed == 0:
                     return done()
@@ -1072,6 +1263,147 @@ class NativeSimulatedNetwork:
             metrics.set_gauge(
                 "consensus_native_handled_messages", self.native_handled()
             )
+
+    # -- pipelined window (era overlap) ----------------------------------------
+    # The windowed scheduler (core/devnet.py) splits every era at the
+    # XO_ROOT_SIGN crossing: the FRONT (propose/encrypt/RBC/BA/coin/
+    # TPKE-verify-combine) runs on the scheduler thread; the TAIL (header
+    # sign + flood + ECDSA verify + produce/commit) runs on a worker thread
+    # that commits eras strictly ascending. Each per-era engine is pumped by
+    # exactly one thread at a time: the scheduler hands the engine to the
+    # tail worker at front-complete and never touches it again.
+    def pipeline_begin(self) -> None:
+        if self.pipeline_window < 1:
+            raise RuntimeError("pipeline_begin requires pipeline_window >= 1")
+        self._sync_ownership()
+        full = OWN_HB | OWN_COIN | OWN_ROOT
+        for r in self.routers:
+            if r._native_mask() != full:
+                raise RuntimeError(
+                    "era pipelining requires full native ownership on every "
+                    f"validator (validator {r._my_id} mask "
+                    f"{r._native_mask():#x}) — python-protocol overrides must "
+                    "run sequentially"
+                )
+        self._pipeline_active = True
+        self._deferred = {}
+
+    def pipeline_end(self) -> None:
+        self._pipeline_active = False
+        self._deferred = {}
+
+    def open_era(self, era: int) -> None:
+        """Admit `era` into the window: give it an engine (scheduler thread
+        only — see _open_era_engine) and forward every router."""
+        self._open_era_engine(era)
+        for r in self.routers:
+            r.open_era(era)
+
+    def commit_era(self, era: int) -> None:
+        """Called by the tail worker after `era`'s block committed: journal
+        GC honoring the overlap window, then retire the era's engine."""
+        for r in self.routers:
+            r.commit_era_gc(era)
+        self._deferred.pop(era, None)
+        self._close_era_engine(era)
+
+    def _defer_sign(self, vid: int, era: int, parity: int) -> bool:
+        """XO_ROOT_SIGN interception point. Outside the pipelined window:
+        decline (the host signs inline). Inside: stash the coin parity —
+        era `era`'s front is complete for `vid` — and once all n validators
+        reach the sign point, break the engine out of its chunk so run_front
+        can return. Muted validators still reach the sign point (they
+        receive everything; muting only gags their sends)."""
+        if not self._pipeline_active:
+            return False
+        d = self._deferred.setdefault(era, {})
+        d[vid] = parity
+        if len(d) >= self.n:
+            h = self._era_engines.get(era)
+            if h is not None:
+                self._lib.rt_request_stop(h)
+        return True
+
+    def front_complete(self, era: int) -> bool:
+        return len(self._deferred.get(era, ())) >= self.n
+
+    def _pump(
+        self, era: int, lane: str, done: Callable[[], bool],
+        max_messages: int, chunk: int,
+    ) -> None:
+        """Shared pump loop for one era's engine on one lane. Flushes ONLY
+        this era's crypto batches (pending_for/flush(era)): lazy builders
+        rt_post into their era's engine, so only the thread owning that
+        engine may flush its submissions."""
+        h = self._era_engines.get(era)
+        if h is None:
+            raise RuntimeError(f"era {era} engine is not open")
+        delivered = 0
+        while not done():
+            processed = self._lib.rt_run(h, chunk)
+            delivered += processed
+            self.delivered_count += processed
+            self._raise_cb_error(era)
+            if (
+                self.crypto_batcher is not None
+                and self.crypto_batcher.pending_for(era)
+                and (
+                    self._lib.rt_queue_len(h) == 0
+                    or self._lib.rt_opaque_pending(h, KIND_DECRYPTED) == 0
+                )
+            ):
+                self.crypto_batcher.flush(era)
+                self._raise_cb_error(era)
+                continue
+            if processed == 0:
+                # in the simulator there is no external input: an idle
+                # engine with nothing to flush and the lane not done is a
+                # genuine wedge
+                raise RuntimeError(self._stall_report(era, lane))
+            if delivered >= max_messages and self._lib.rt_queue_len(h) > 0:
+                raise RuntimeError(
+                    f"era {era} {lane}: message cap {max_messages} "
+                    "exceeded — livelock?"
+                )
+
+    def run_front(
+        self, era: int, max_messages: int = 2_000_000, chunk: int = 16384
+    ) -> None:
+        """Pump era `era` until every validator's front is complete (all n
+        sign-deferred). Scheduler thread only."""
+        self._pump(
+            era, "front", lambda: self.front_complete(era),
+            max_messages, chunk,
+        )
+
+    def run_tail(
+        self, era: int, max_messages: int = 2_000_000, chunk: int = 16384
+    ) -> List[Any]:
+        """Resume the deferred signs and pump era `era` to block production
+        on every router. Tail-worker thread only; eras strictly ascending."""
+        pid = M.RootProtocolId(era=era)
+        deferred = self._deferred.get(era, {})
+        for vid in range(self.n):
+            self.routers[vid].root_host(era).on_sign(deferred[vid])
+            self._raise_cb_error(era)
+
+        def tail_done() -> bool:
+            return all(pid in r._native_results for r in self.routers)
+
+        self._pump(era, "tail", tail_done, max_messages, chunk)
+        return [r._native_results[pid] for r in self.routers]
+
+    def _stall_report(self, era: int, lane: str) -> str:
+        in_flight = sorted(self._era_engines)
+        lines = [
+            f"consensus pipeline stalled: era {era} ({lane} lane) wedged; "
+            f"in-flight eras {in_flight}"
+        ]
+        for vid in range(self.n):
+            lines.append(
+                f"  validator {vid}: {self.native_state_of(vid, era=era)}"
+            )
+        return "\n".join(lines)
 
     def results(self, pid) -> List[Any]:
         return [r.result_of(pid) for r in self.routers]

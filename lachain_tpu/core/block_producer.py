@@ -70,11 +70,46 @@ class BlockProducer:
         # HB blocks carry the union of n proposals, so identical top-fee
         # picks would cap blocks at txs_per_block / n distinct txs
         self.proposal_seed = proposal_seed
+        # pipelined-proposal overlay: when era e+1 proposes while era e's
+        # block is decided but not yet committed, the proposal must behave
+        # as if that block had already landed — same rng height, no tx
+        # claimed by an in-flight block, per-sender nonces advanced past
+        # the in-flight ones. The window scheduler installs it before the
+        # proposal and clears it when the window drains.
+        self._ov_height: Optional[int] = None
+        self._ov_exclude: set = set()
+        self._ov_nonces: dict = {}
 
     # -- proposal ---------------------------------------------------------------
+    def pipeline_overlay_push(
+        self, height: int, txs: Sequence[SignedTransaction], chain_id: int
+    ) -> None:
+        """Extend the overlay with one in-flight block: proposals now build
+        on virtual height `height` (the next block index) and skip `txs`.
+        Cumulative — called once per decided-but-uncommitted era."""
+        self._ov_height = height
+        for stx in txs:
+            self._ov_exclude.add(stx.hash())
+            sender = stx.sender(chain_id)
+            if sender is None:
+                continue
+            nxt = stx.tx.nonce + 1
+            if nxt > self._ov_nonces.get(sender, 0):
+                self._ov_nonces[sender] = nxt
+
+    def pipeline_overlay_clear(self) -> None:
+        self._ov_height = None
+        self._ov_exclude = set()
+        self._ov_nonces = {}
+
     def get_transactions_to_propose(self) -> List[SignedTransaction]:
+        height = (
+            self._ov_height
+            if self._ov_height is not None
+            else self.bm.current_height()
+        )
         rng = (
-            random.Random((self.proposal_seed << 20) ^ self.bm.current_height())
+            random.Random((self.proposal_seed << 20) ^ height)
             if self.proposal_seed >= 0
             else None
         )
@@ -82,6 +117,8 @@ class BlockProducer:
             max(self.txs_per_block // max(self.n, 1), 1),
             rng=rng,
             window_txs=2 * self.txs_per_block,
+            exclude=self._ov_exclude if self._ov_exclude else None,
+            nonce_override=self._ov_nonces if self._ov_nonces else None,
         )
 
     # -- header -----------------------------------------------------------------

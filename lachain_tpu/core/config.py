@@ -201,6 +201,12 @@ class RpcSection:
 class BlockchainSection:
     target_txs_per_block: int = 1000
     target_block_time_ms: int = 1000
+    # consensus era pipelining lookahead (DEPLOY.md "Consensus
+    # pipelining"): 0 = strictly sequential eras; w >= 1 admits era e+w's
+    # proposal/RBC while era e is still in decrypt/commit. Raises journal
+    # retention and peak memory by ~w eras — turn off on memory-constrained
+    # validators.
+    pipeline_window: int = 0
 
 
 @dataclass
@@ -297,6 +303,7 @@ class NodeConfig:
             blockchain=BlockchainSection(
                 target_txs_per_block=int(bc.get("targetTxsPerBlock", 1000)),
                 target_block_time_ms=int(bc.get("targetBlockTimeMs", 1000)),
+                pipeline_window=int(bc.get("pipelineWindow", 0)),
             ),
             hardfork=HardforkSection(
                 heights={k: int(v) for k, v in hf.get("heights", {}).items()}

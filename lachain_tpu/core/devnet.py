@@ -67,9 +67,17 @@ class Devnet:
         fault_plan=None,
         max_recovery_rounds: int = 16,
         kv_factory: Optional[Callable[[int], KVStore]] = None,
+        pipeline_window: int = 0,
+        journals: Optional[List] = None,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
+        # pipeline_window > 0 turns run_eras into a windowed scheduler that
+        # overlaps era e+1's front (propose/RBC/BA/coin/TPKE) with era e's
+        # tail (sign/verify/commit) — native engine only
+        self.pipeline_window = max(int(pipeline_window), 0)
+        if self.pipeline_window > 0 and engine != "native":
+            raise ValueError("era pipelining requires engine='native'")
         rng = random.Random(seed)
 
         class _Rng:
@@ -136,12 +144,17 @@ class Devnet:
             from ..consensus.native_rt import NativeSimulatedNetwork
 
             net_cls = NativeSimulatedNetwork
-            net_kw = dict(fault_plan=fault_plan)
+            net_kw = dict(
+                fault_plan=fault_plan,
+                pipeline_window=self.pipeline_window,
+                journals=journals,
+            )
         else:
             net_cls = SimulatedNetwork
             net_kw = dict(
                 fault_plan=fault_plan,
                 max_recovery_rounds=max_recovery_rounds,
+                journals=journals,
             )
         self.net = net_cls(
             self.public_keys,
@@ -212,11 +225,139 @@ class Devnet:
         assert all(b.hash() == h0 for b in blocks), "devnet fork!"
         return blocks
 
-    def run_eras(self, first: int, count: int) -> List[Block]:
+    def run_eras(
+        self, first: int, count: int, max_messages: int = 2_000_000
+    ) -> List[Block]:
+        if self.pipeline_window > 0:
+            return self._run_eras_pipelined(
+                first, count, max_messages=max_messages
+            )
         out = []
         for era in range(first, first + count):
-            out.append(self.run_era(era)[0])
+            out.append(self.run_era(era, max_messages=max_messages)[0])
         return out
+
+    # -- pipelined era window ---------------------------------------------------
+    def _decided_txs(self, era: int) -> List[SignedTransaction]:
+        """The tx set era `era`'s block WILL carry, derived from router 0's
+        HB result exactly as RootHost.on_sign derives it (the result is
+        content-identical at every validator, so router 0 suffices).
+        Available at front-complete — before the block itself exists."""
+        from .block_producer import decode_tx_batch
+
+        hb_result = self.net.routers[0].hb_host(era).result or {}
+        seen = set()
+        txs: List[SignedTransaction] = []
+        for slot in sorted(hb_result):
+            try:
+                batch = decode_tx_batch(hb_result[slot])
+            except (ValueError, AssertionError):
+                continue
+            for stx in batch:
+                h = stx.hash()
+                if h not in seen:
+                    seen.add(h)
+                    txs.append(stx)
+        return txs
+
+    def _run_eras_pipelined(
+        self, first: int, count: int, max_messages: int = 2_000_000
+    ) -> List[Block]:
+        """Windowed era scheduler: era e+1's FRONT (propose/encrypt/RBC/BA/
+        coin/TPKE verify-combine, up to the deferred header sign) runs on
+        this thread while era e's TAIL (sign/flood/ECDSA-verify/produce/
+        commit) runs on a worker thread. Commits stay strictly sequential
+        (the tail worker processes eras ascending), so state roots — and
+        block hashes — are exactly the sequential run's. At most
+        pipeline_window + 1 eras are in flight at once."""
+        import queue as queue_mod
+        import threading
+
+        from ..utils import metrics, tracing
+
+        window = self.pipeline_window
+        eras = list(range(first, first + count))
+        self.net.pipeline_begin()
+        committed = {e: threading.Event() for e in eras}
+        blocks: Dict[int, Block] = {}
+        era_spans: Dict[int, int] = {}
+        tail_q: "queue_mod.Queue" = queue_mod.Queue()
+        tail_err: List[BaseException] = []
+
+        def tail_worker() -> None:
+            while True:
+                era = tail_q.get()
+                if era is None:
+                    return
+                try:
+                    with tracing.span("era.tail", era=era):
+                        era_blocks = self.net.run_tail(
+                            era, max_messages=max_messages
+                        )
+                        h0 = era_blocks[0].hash()
+                        assert all(
+                            b.hash() == h0 for b in era_blocks
+                        ), "devnet fork!"
+                        self.net.commit_era(era)
+                    blocks[era] = era_blocks[0]
+                    tracing.end(era_spans[era])
+                    committed[era].set()
+                except BaseException as exc:  # noqa: BLE001
+                    tail_err.append(exc)
+                    committed[era].set()  # unblock the scheduler
+                    return
+
+        worker = threading.Thread(
+            target=tail_worker, name="consensus-tail", daemon=True
+        )
+        worker.start()
+        in_flight: List[int] = []
+        try:
+            for era in eras:
+                # admission: keep at most window fronts ahead of the
+                # oldest uncommitted era
+                while len(in_flight) > window:
+                    committed[in_flight[0]].wait()
+                    if tail_err:
+                        raise tail_err[0]
+                    in_flight.pop(0)
+                if tail_err:
+                    raise tail_err[0]
+                # the "era" span opens at admission and closes at commit
+                # (on the tail thread): neighbor eras' spans genuinely
+                # overlap, which is what era_report's overlap_s measures
+                era_spans[era] = tracing.begin("era", era=era)
+                self.net.open_era(era)
+                pid = M.RootProtocolId(era=era)
+                for i in range(self.n):
+                    self.net.post_request(i, pid, None)
+                with tracing.span("era.front", era=era):
+                    self.net.run_front(era, max_messages=max_messages)
+                in_flight.append(era)
+                metrics.set_gauge("consensus_pipeline_depth", len(in_flight))
+                if era != eras[-1]:
+                    # before era+1 proposes: overlay this era's decided tx
+                    # set so the next proposal behaves as if the block had
+                    # already committed (main thread — the overlay is only
+                    # read here, by the next post_request's proposal)
+                    txs = self._decided_txs(era)
+                    for node in self.nodes:
+                        node.producer.pipeline_overlay_push(
+                            era, txs, self.chain_id
+                        )
+                tail_q.put(era)
+            for era in in_flight:
+                committed[era].wait()
+                if tail_err:
+                    raise tail_err[0]
+        finally:
+            tail_q.put(None)
+            worker.join(timeout=60)
+            metrics.set_gauge("consensus_pipeline_depth", 0)
+            for node in self.nodes:
+                node.producer.pipeline_overlay_clear()
+            self.net.pipeline_end()
+        return [blocks[e] for e in eras]
 
     # -- helpers ------------------------------------------------------------------
     def close(self) -> None:

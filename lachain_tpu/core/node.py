@@ -67,8 +67,15 @@ class Node:
         block_interval: float = 0.0,
         advertise_host: Optional[str] = None,
         relay=None,  # "host:port:pubhex" or a list of them — NAT'd mode
+        pipeline_window: int = 0,
     ):
         self.index = index
+        # era-pipelining lookahead (config blockchain.pipelineWindow). On a
+        # TCP node the window widens message acceptance and journal/GC
+        # retention so pipelining peers (and the in-process devnet
+        # scheduler) interoperate; the windowed front/tail overlap itself
+        # is driven by the in-process scheduler (core/devnet.py).
+        self.pipeline_window = max(int(pipeline_window), 0)
         self.public_keys = public_keys
         self.private_keys = private_keys
         self.chain_id = chain_id
@@ -384,7 +391,13 @@ class Node:
             return 0
         if now - mark <= self.stall_timeout:
             return 0
-        if router.result_of(M.RootProtocolId(era=router.era)) is not None:
+        # with pipelining the router spans a window of in-flight eras;
+        # commits are strictly sequential, so the stuck era is the OLDEST
+        # uncommitted one (window_floor), not the newest admitted
+        stuck_era = router.era
+        if self.pipeline_window > 0:
+            stuck_era = getattr(router, "window_floor", router.era)
+        if router.result_of(M.RootProtocolId(era=stuck_era)) is not None:
             # era complete on our side; quiet engine state is expected
             self._native_watch = (native_state, now, 0)
             return 0
@@ -395,14 +408,14 @@ class Node:
             "native engine stalled for %.0fs in era %d (strike %d, "
             "engine state: %s)",
             now - mark,
-            router.era,
+            stuck_era,
             strikes,
             native_state,
         )
         tracing.instant(
             "watchdog_stall",
             cat="watchdog",
-            pid=f"native:era{router.era}",
+            pid=f"native:era{stuck_era}",
             stalled_s=round(now - mark, 1),
             stage=strikes,
             last_message="",
@@ -683,7 +696,9 @@ class Node:
                 extra_factories={M.RootProtocolId: self._root_factory},
                 journal=self.journal,
             )
+            self.router.pipeline_window = self.pipeline_window
         else:
+            self.router.pipeline_window = self.pipeline_window
             self.router.advance_era(era)
         self._replay_future()
         return self.router

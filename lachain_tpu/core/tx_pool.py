@@ -100,6 +100,8 @@ class TransactionPool:
         max_txs: int,
         rng: Optional["random.Random"] = None,
         window_txs: Optional[int] = None,
+        exclude: Optional[Set[bytes]] = None,
+        nonce_override: Optional[Dict[bytes, int]] = None,
     ) -> List[SignedTransaction]:
         """Fee-ordered proposal with per-sender nonce continuity.
 
@@ -112,10 +114,19 @@ class TransactionPool:
         txs, not one proposal's worth: n validators sampling 4*max_txs
         txs can union to at most 4*max_txs distinct entries. Sampling
         keeps per-sender nonce chains contiguous by sampling SENDERS,
-        then taking their chain prefixes."""
+        then taking their chain prefixes.
+
+        `exclude` / `nonce_override` are the pipelined-proposal overlay:
+        when proposing on top of in-flight (decided but uncommitted) blocks,
+        the caller masks txs already claimed by those blocks and advances
+        the per-sender chain start past their nonces — state reads still
+        see the committed trie, which is exactly the sequential outcome
+        once the in-flight blocks land."""
         if rng is not None:
             window = self._peek_ordered_with_senders(
-                window_txs if window_txs is not None else 4 * max_txs
+                window_txs if window_txs is not None else 4 * max_txs,
+                exclude=exclude,
+                nonce_override=nonce_override,
             )
             if len(window) > max_txs:
                 by_sender: Dict[bytes, List[SignedTransaction]] = {}
@@ -134,23 +145,43 @@ class TransactionPool:
                         break
                 return picked
             return [stx for _, stx in window]
-        return self._peek_ordered(max_txs)
+        return self._peek_ordered(
+            max_txs, exclude=exclude, nonce_override=nonce_override
+        )
 
-    def _peek_ordered(self, max_txs: int) -> List[SignedTransaction]:
-        return [stx for _, stx in self._peek_ordered_with_senders(max_txs)]
+    def _peek_ordered(
+        self,
+        max_txs: int,
+        exclude: Optional[Set[bytes]] = None,
+        nonce_override: Optional[Dict[bytes, int]] = None,
+    ) -> List[SignedTransaction]:
+        return [
+            stx
+            for _, stx in self._peek_ordered_with_senders(
+                max_txs, exclude=exclude, nonce_override=nonce_override
+            )
+        ]
 
     def _peek_ordered_with_senders(
-        self, max_txs: int
+        self,
+        max_txs: int,
+        exclude: Optional[Set[bytes]] = None,
+        nonce_override: Optional[Dict[bytes, int]] = None,
     ) -> List[Tuple[bytes, SignedTransaction]]:
         with self._lock:
             per_sender: Dict[bytes, List[SignedTransaction]] = {}
             for h, stx in self._txs.items():
+                if exclude is not None and h in exclude:
+                    continue  # claimed by an in-flight block
                 per_sender.setdefault(self._senders[h], []).append(stx)
             # per-sender executable chains, nonce-ascending
             chains: Dict[bytes, List[SignedTransaction]] = {}
             for sender, txs in per_sender.items():
                 txs.sort(key=lambda t: t.tx.nonce)
-                nonce = self._account_nonce(sender)
+                if nonce_override is not None and sender in nonce_override:
+                    nonce = nonce_override[sender]
+                else:
+                    nonce = self._account_nonce(sender)
                 chain = []
                 for t in txs:
                     if t.tx.nonce != nonce:

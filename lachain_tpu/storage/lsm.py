@@ -122,6 +122,13 @@ def _load_lib():
         ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
         ctypes.POINTER(ctypes.c_size_t),
     ]
+    lib.lsm_scan_from.restype = ctypes.c_int
+    lib.lsm_scan_from.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
     lib.lsm_flush.restype = ctypes.c_int
     lib.lsm_flush.argtypes = [ctypes.c_void_p]
     lib.lsm_compact_now.restype = ctypes.c_int
@@ -137,7 +144,7 @@ def _load_lib():
     lib.lsm_table_count.restype = ctypes.c_uint64
     lib.lsm_table_count.argtypes = [ctypes.c_void_p]
     lib.lsm_version.restype = ctypes.c_int
-    assert lib.lsm_version() == 3
+    assert lib.lsm_version() == 4
     lib.lsm_monotonic_ns.restype = ctypes.c_uint64
     lib.lsm_monotonic_ns.argtypes = []
     lib.lsm_trace_configure.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -369,6 +376,44 @@ class LsmKV(KVStore):
             v = data[off : off + vlen]
             off += vlen
             yield (k, v)
+
+    def scan_from(
+        self, prefix: bytes, after: bytes, limit: int
+    ) -> List[Tuple[bytes, bytes]]:
+        """Bounded native cursor page (the fast-sync snapshot primitive):
+        the engine seeks its SSTable cursors and memtable skiplists to
+        prefix+after and merges forward for `limit` live rows — O(seek +
+        page) instead of the O(keyspace) full-prefix materialization the
+        KVStore default pays via scan_prefix. Row identity with the
+        default/SqliteKV pager is test-locked (tests/test_lsm.py)."""
+        if limit <= 0:
+            return []
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        blen = ctypes.c_size_t(0)
+        if (
+            self._lib.lsm_scan_from(
+                self._h, prefix, len(prefix), after, len(after),
+                limit, ctypes.byref(buf), ctypes.byref(blen),
+            )
+            != 0
+        ):
+            raise IOError("LSM scan_from failed")
+        try:
+            data = ctypes.string_at(buf, blen.value)
+        finally:
+            self._lib.lsm_free(buf)
+        out: List[Tuple[bytes, bytes]] = []
+        off = 4
+        for _ in range(int.from_bytes(data[0:4], "little")):
+            klen = int.from_bytes(data[off : off + 4], "little")
+            off += 4
+            k = data[off : off + klen]
+            off += klen
+            vlen = int.from_bytes(data[off : off + 4], "little")
+            off += 4
+            out.append((k, data[off : off + vlen]))
+            off += vlen
+        return out
 
     def flush(self) -> None:
         """Seal the memtable and wait until it is a durable sorted table."""

@@ -1545,6 +1545,80 @@ struct Lsm {
     return true;
   }
 
+  // Bounded cursor page: the first `limit` LIVE rows under `prefix` whose
+  // key is strictly greater than `start` (exclusive=false makes `start`
+  // itself eligible — the "from the front" page). K-way merge over seeked
+  // SSTable cursors and memtable skiplist iterators, newest level winning
+  // key ties, tombstones consuming their key. A fast-sync snapshot page
+  // costs O(seek + page), not the O(keyspace) materialization scan_prefix
+  // pays.
+  bool scan_from(std::string_view prefix, std::string_view start,
+                 bool exclusive, u64 limit, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    size_t n_tab = tables.size();
+    std::vector<TableCursor> tc(n_tab);
+    for (size_t i = 0; i < n_tab; i++) {
+      tc[i].seek(tables[i].get(), start);
+      if (tc[i].io_error) return false;
+    }
+    // oldest -> newest so the LAST holder of a key in this list is the
+    // freshest version: imm is a seal queue (front = oldest), mem newest
+    std::vector<SkipNode*> mc;
+    for (auto& m : imm) mc.push_back(m->lower_bound(start));
+    mc.push_back(mem->lower_bound(start));
+    out.clear();
+    u32 count = 0;
+    std::string body, key;
+    while (count < limit) {
+      bool any = false;
+      std::string_view min_key;
+      for (auto& c : tc)
+        if (c.valid && (!any || c.key() < min_key)) {
+          min_key = c.key();
+          any = true;
+        }
+      for (auto* n : mc)
+        if (n && (!any || n->key < min_key)) {
+          min_key = n->key;
+          any = true;
+        }
+      if (!any || min_key.substr(0, prefix.size()) != prefix) break;
+      key.assign(min_key.data(), min_key.size());
+      bool del = false;
+      std::string_view val;
+      for (auto& c : tc)
+        if (c.valid && c.key() == std::string_view(key)) {
+          del = c.del();
+          val = c.val();
+        }
+      for (auto* n : mc)
+        if (n && n->key == std::string_view(key)) {
+          del = n->del;
+          val = n->val;
+        }
+      if (!del && !(exclusive && std::string_view(key) == start)) {
+        put_u32(body, (u32)key.size());
+        body += key;
+        put_u32(body, (u32)val.size());
+        body.append(val.data(), val.size());
+        count++;
+      }
+      // advance every holder past this key (views into cursor blocks die
+      // here, which is why `key` was copied and `val` already appended)
+      for (auto& c : tc) {
+        while (c.valid && c.key() == std::string_view(key)) {
+          c.step();
+          if (c.io_error) return false;
+        }
+      }
+      for (auto*& n : mc)
+        while (n && n->key == std::string_view(key)) n = n->next[0];
+    }
+    put_u32(out, count);
+    out += body;
+    return true;
+  }
+
   // ---- flush / shutdown ----------------------------------------------------
 
   // Explicit flush: seal the active memtable and wait until every sealed
@@ -1679,6 +1753,21 @@ int lsm_scan_prefix(void* h, const u8* prefix, size_t plen, u8** buf,
   return 0;
 }
 
+int lsm_scan_from(void* h, const u8* prefix, size_t plen, const u8* after,
+                  size_t alen, u64 limit, u8** buf, size_t* len) {
+  std::string start((const char*)prefix, plen);
+  if (alen) start.append((const char*)after, alen);
+  std::string out;
+  if (!static_cast<Lsm*>(h)->scan_from(
+          std::string_view((const char*)prefix, plen), start,
+          /*exclusive=*/alen > 0, limit, out))
+    return -1;
+  *buf = (u8*)malloc(out.size() ? out.size() : 1);
+  memcpy(*buf, out.data(), out.size());
+  *len = out.size();
+  return 0;
+}
+
 int lsm_flush(void* h) { return static_cast<Lsm*>(h)->flush(); }
 
 int lsm_compact_now(void* h) {
@@ -1762,6 +1851,6 @@ u64 lsm_trace_drain(void* h, u8* buf, u64 cap) {
   return out.size();
 }
 
-int lsm_version() { return 3; }
+int lsm_version() { return 4; }
 
 }  // extern "C"

@@ -540,6 +540,25 @@ def era_report(
     for era in sorted(windows):
         lo, hi = windows[era]
         wall = max(hi - lo, 0.0)
+        # pipelining overlap: how much of this era's window was shared
+        # with ANY other in-flight era (intersection with the union of the
+        # other windows). 0 everywhere means the eras ran sequentially.
+        other = sorted(
+            (max(s, lo), min(e, hi))
+            for o, (s, e) in windows.items()
+            if o != era and min(e, hi) > max(s, lo)
+        )
+        overlap = 0.0
+        cur_s = cur_e = None
+        for s, e in other:
+            if cur_e is None or s > cur_e:
+                if cur_e is not None:
+                    overlap += cur_e - cur_s
+                cur_s, cur_e = s, e
+            else:
+                cur_e = max(cur_e, e)
+        if cur_e is not None:
+            overlap += cur_e - cur_s
         phases = _sweep(per_era_iv[era], lo, hi)
         # engine dispatch time is measured OUTSIDE the crossing callbacks
         # (cross time subtracted natively), so it is exclusive of every
@@ -554,6 +573,7 @@ def era_report(
                 "wall_s": round(wall, 6),
                 "phases_s": {p: round(phases[p], 6) for p in PHASES},
                 "idle_s": round(idle, 6),
+                "overlap_s": round(overlap, 6),
                 "attributed_s": round(attributed, 6),
                 "coverage": round(
                     (attributed + idle) / wall, 4
@@ -567,13 +587,13 @@ def era_report_table(report: Optional[dict] = None) -> str:
     """Plain-text per-era phase table (CLI `trace --era-report`)."""
     if report is None:
         report = era_report()
-    cols = ["era", "wall_s"] + list(PHASES) + ["idle_s"]
+    cols = ["era", "wall_s"] + list(PHASES) + ["idle_s", "overlap_s"]
     rows = [cols]
     for ent in report["eras"]:
         rows.append(
             [str(ent["era"]), f"{ent['wall_s']:.3f}"]
             + [f"{ent['phases_s'][p]:.3f}" for p in PHASES]
-            + [f"{ent['idle_s']:.3f}"]
+            + [f"{ent['idle_s']:.3f}", f"{ent.get('overlap_s', 0.0):.3f}"]
         )
     if len(rows) == 1:
         return "<no completed eras in trace ring>"

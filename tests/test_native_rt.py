@@ -300,9 +300,9 @@ def test_native_journal_replay_for_native_protocols():
     engine_bcasts = []
     orig_bcast = r0._net._bcast_opaque
 
-    def count_bcast(vid, kind, a, b, data):
+    def count_bcast(vid, kind, a, b, data, era=None):
         engine_bcasts.append(kind)
-        return orig_bcast(vid, kind, a, b, data)
+        return orig_bcast(vid, kind, a, b, data, era=era)
 
     r0._net._bcast_opaque = count_bcast
     assert r0.replay_outbox(0, 1) == len(list(journals[0].entries()))
