@@ -8,7 +8,8 @@ PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-storage \
-	test-observability test-sync test-pipeline native bench bench-gate
+	test-observability test-sync test-pipeline test-exec native bench \
+	bench-gate
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -52,6 +53,15 @@ test-pipeline:
 # core/synchronizer.py or the trie-serving wire kinds
 test-sync:
 	$(PYTEST) $(PYTEST_ARGS) -m "sync and not slow"
+
+# optimistic lane-parallel execution: plan/run/merge determinism, the
+# randomized serial-vs-parallel differential (receipts + roots + trie
+# node sets bit-identical), forced-conflict degradation, delta
+# checkpoints, sharded pool admission. The slice to run after touching
+# core/parallel_exec.py, core/execution.py, storage/state.py checkpoints
+# or core/tx_pool.py
+test-exec:
+	$(PYTEST) $(PYTEST_ARGS) -m exec
 
 test:
 	$(PYTEST) $(PYTEST_ARGS)

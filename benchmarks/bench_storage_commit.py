@@ -46,14 +46,18 @@ def _make_txs(n_txs: int, chain: int):
     ]
     txs = []
     per_user = (n_txs + len(users) - 1) // len(users)
-    for priv in users:
+    for ui, priv in enumerate(users):
+        # per-user recipient: footprints stay disjoint across users, so
+        # the lane planner can actually spread the block (one shared
+        # recipient would collapse every tx into a single lane)
+        to = b"\x09" * 12 + ui.to_bytes(8, "big")
         for n in range(per_user):
             if len(txs) >= n_txs:
                 break
             txs.append(
                 sign_transaction(
                     Transaction(
-                        to=b"\x09" * 20,
+                        to=to,
                         value=1,
                         nonce=n,
                         gas_price=1,
@@ -67,10 +71,14 @@ def _make_txs(n_txs: int, chain: int):
     return txs, addrs
 
 
-def bench_engine(engine: str, txs, addrs, chain: int) -> dict:
+def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
     """One full commit-path measurement on a fresh store of `engine`."""
     from lachain_tpu.core import system_contracts
     from lachain_tpu.core.block_manager import BlockManager
+    from lachain_tpu.core.parallel_exec import (
+        execute_block_parallel,
+        resolve_lanes,
+    )
     from lachain_tpu.core.types import BlockHeader, MultiSig, tx_merkle_root
     from lachain_tpu.storage.kv import SqliteKV
     from lachain_tpu.storage.lsm import LsmKV
@@ -87,6 +95,7 @@ def bench_engine(engine: str, txs, addrs, chain: int) -> dict:
         bm.build_genesis({a: 10**24 for a in addrs}, chain)
 
         ordered = bm.order_transactions(txs, chain)
+        base = state.committed
         t0 = time.perf_counter()
         em = bm.emulate(ordered, 1)
         t_emulate = time.perf_counter() - t0
@@ -107,6 +116,33 @@ def bench_engine(engine: str, txs, addrs, chain: int) -> dict:
         t0 = time.perf_counter()
         kv.write_batch(payload)
         t_raw = time.perf_counter() - t0
+
+        # serial-oracle vs lane-parallel differential over the SAME
+        # pre-block base roots: times both paths and proves the roots
+        # agree in the same run (the bit-identity acceptance check).
+        # Runs AFTER the commit measurements — two extra 10k-tx passes
+        # leave enough allocator/GC residue to skew them otherwise
+        t0 = time.perf_counter()
+        snap = state.new_snapshot(base)
+        for i, stx in enumerate(ordered):
+            bm.executer.execute(snap, stx, 1, i)
+        serial_roots = snap.freeze()
+        t_serial_exec = time.perf_counter() - t0
+        n_lanes = resolve_lanes(lanes)
+        t0 = time.perf_counter()
+        merged, _receipts, stats = execute_block_parallel(
+            bm.executer, state, ordered, 1, base, n_lanes
+        )
+        parallel_roots = merged.freeze()
+        t_parallel_exec = time.perf_counter() - t0
+        if parallel_roots != serial_roots:
+            raise SystemExit(
+                f"{engine}: parallel roots diverged from the serial oracle"
+            )
+        if serial_roots.state_hash() != em.state_hash:
+            raise SystemExit(
+                f"{engine}: differential base diverged from the block run"
+            )
         kv.close()
 
     return {
@@ -117,6 +153,12 @@ def bench_engine(engine: str, txs, addrs, chain: int) -> dict:
         "txs": len(txs),
         "emulate_s": round(t_emulate, 3),
         "tx_per_s_commit": round(len(txs) / t_commit, 1),
+        "exec_serial_s": round(t_serial_exec, 3),
+        "exec_parallel_s": round(t_parallel_exec, 3),
+        "exec_lanes": stats.lanes,
+        "exec_stragglers": stats.stragglers,
+        "exec_conflict_rate": round(stats.conflict_rate, 4),
+        "parallel_roots_identical": True,
         "raw_batch_10k_puts_s": round(t_raw, 3),
         "state_root": state_root,
         "store": (
@@ -135,12 +177,19 @@ def main() -> None:
         default="sqlite,lsm",
         help="comma-separated engine list, each benched on a fresh store",
     )
+    ap.add_argument(
+        "--lanes",
+        type=int,
+        default=0,
+        help="parallel-execution lanes for the differential leg "
+        "(0 = auto from cores, 1 = serial)",
+    )
     args = ap.parse_args()
 
     chain = 515
     txs, addrs = _make_txs(args.txs, chain)
     rows = [
-        bench_engine(e.strip(), txs, addrs, chain)
+        bench_engine(e.strip(), txs, addrs, chain, lanes=args.lanes)
         for e in args.engines.split(",")
         if e.strip()
     ]

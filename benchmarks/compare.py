@@ -38,6 +38,10 @@ LATENCY_FIELDS = (
     "fastsync_failover_recovery_s",
 )
 
+# throughput-shaped side fields compared higher-is-better when both runs
+# report them (bench_storage_commit rows carry committed tx/s)
+THROUGHPUT_FIELDS = ("tx_per_s_commit",)
+
 
 def load_result(path: str) -> dict:
     """File/stdin -> bare result dict (unwraps the driver envelope)."""
@@ -114,6 +118,9 @@ def compare(base: dict, cur: dict, floor: float) -> Tuple[int, str]:
         (f, False)
         for f in LATENCY_FIELDS
         if f in base and f in cur and f != "baseline_era_s"
+    ]
+    checks += [
+        (f, True) for f in THROUGHPUT_FIELDS if f in base and f in cur
     ]
     for field, field_hb in checks:
         try:

@@ -15,6 +15,7 @@ validator signs in its header is exactly what executing the block produces.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -23,8 +24,14 @@ from ..storage.kv import EntryPrefix, KVStore, prefixed
 from ..storage.state import Snapshot, StateManager, StateRoots
 from ..utils import metrics
 from ..utils import bloom
+from ..utils import tracing
 from ..utils.serialization import write_u32, write_u64
 from .execution import TransactionExecuter, set_balance
+from .parallel_exec import (
+    MIN_PARALLEL_TXS,
+    execute_block_parallel,
+    resolve_lanes,
+)
 from .types import (
     Block,
     BlockHeader,
@@ -42,12 +49,19 @@ class EmulationResult:
     roots: StateRoots
     state_hash: bytes
     receipts: List
+    # 20-byte emitting-contract addresses of THIS block's events, captured
+    # from the snapshot write buffer before freeze — _persist builds the
+    # per-block log bloom from these instead of probing the trie per tx
+    event_addrs: Tuple[bytes, ...] = ()
 
 
 # process-wide emulation memo: key -> (EmulationResult, exported trie node
 # buffer); bounded FIFO. See BlockManager.emulate for the sharing argument.
+# Lock-guarded: parallel-execution lane workers and the pipelined-era
+# scheduler can emulate from different threads concurrently.
 _EMULATE_MEMO: Dict[tuple, Tuple[EmulationResult, dict]] = {}
 _EMULATE_MEMO_MAX = 8
+_EMULATE_MEMO_LOCK = threading.Lock()
 
 
 class BlockManager:
@@ -56,10 +70,15 @@ class BlockManager:
         kv: KVStore,
         state: StateManager,
         executer: TransactionExecuter,
+        lanes: int = 1,
     ):
         self._kv = kv
         self.state = state
         self.executer = executer
+        # execution.lanes knob: 1 pins the serial oracle (default), N>1
+        # fixes the lane count, 0 = auto (cores, capped). Results are
+        # bit-identical either way (core/parallel_exec.py).
+        self.lanes = max(int(lanes), 0)
         self.on_block_persisted = []  # callbacks(block)
 
     # -- ordering (deterministic across validators) ---------------------------
@@ -106,23 +125,43 @@ class BlockManager:
             self.executer.chain_id,
             tuple(stx.hash() for stx in txs),
         )
-        hit = _EMULATE_MEMO.get(key)
+        with _EMULATE_MEMO_LOCK:
+            hit = _EMULATE_MEMO.get(key)
         if hit is not None:
             em, nodes = hit
             self.state.trie.absorb_pending(nodes)
             return em
-        snap = self.state.new_snapshot(base_roots)
-        receipts = []
-        for i, stx in enumerate(txs):
-            res = self.executer.execute(snap, stx, block_index, i)
-            receipts.append(res.receipt)
-        roots = snap.freeze()
+        lanes = resolve_lanes(self.lanes)
+        with tracing.span("exec.block", cat="exec", era=block_index):
+            if lanes > 1 and len(txs) >= MIN_PARALLEL_TXS:
+                snap, receipts, _stats = execute_block_parallel(
+                    self.executer,
+                    self.state,
+                    txs,
+                    block_index,
+                    base_roots,
+                    lanes,
+                )
+            else:
+                snap = self.state.new_snapshot(base_roots)
+                receipts = []
+                for i, stx in enumerate(txs):
+                    res = self.executer.execute(snap, stx, block_index, i)
+                    receipts.append(res.receipt)
+            event_addrs = tuple(
+                v[:20] for v in snap._writes["events"].values() if v
+            )
+            roots = snap.freeze()
         em = EmulationResult(
-            roots=roots, state_hash=roots.state_hash(), receipts=receipts
+            roots=roots,
+            state_hash=roots.state_hash(),
+            receipts=receipts,
+            event_addrs=event_addrs,
         )
-        _EMULATE_MEMO[key] = (em, self.state.trie.export_pending())
-        while len(_EMULATE_MEMO) > _EMULATE_MEMO_MAX:
-            _EMULATE_MEMO.pop(next(iter(_EMULATE_MEMO)))
+        with _EMULATE_MEMO_LOCK:
+            _EMULATE_MEMO[key] = (em, self.state.trie.export_pending())
+            while len(_EMULATE_MEMO) > _EMULATE_MEMO_MAX:
+                _EMULATE_MEMO.pop(next(iter(_EMULATE_MEMO)))
         return em
 
     # -- execute + commit ------------------------------------------------------
@@ -199,18 +238,12 @@ class BlockManager:
                 )
         # per-block log bloom over emitting addresses: eth_getLogs and the
         # filter machinery skip non-matching blocks without decoding events
-        # (reference: Misc/BloomFilter.cs)
+        # (reference: Misc/BloomFilter.cs). The emulation captured the
+        # block's emitting addresses from its write buffer, so the bloom
+        # costs |events| adds instead of a trie probe per (tx, event index)
         bl = bloom.empty()
-        snap = self.state.new_snapshot(em.roots)
-        for stx in txs:
-            th = stx.hash()
-            i = 0
-            while True:
-                raw = snap.get("events", th + write_u32(i))
-                if raw is None:
-                    break
-                bloom.add(bl, raw[:20])
-                i += 1
+        for addr in em.event_addrs:
+            bloom.add(bl, addr)
         puts.append(
             (
                 prefixed(

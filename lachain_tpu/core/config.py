@@ -247,6 +247,16 @@ class NodeConfig:
         return engine
 
     @property
+    def execution_lanes(self) -> int:
+        """Parallel-execution lane count (DEPLOY.md "Parallel execution").
+        Optional and additive (no config version bump): 1 pins the serial
+        executor, N > 1 fixes the lane count, 0 (the default) sizes lanes
+        from the host's cores. Every setting produces bit-identical
+        blocks — the knob trades merge/validation overhead against core
+        utilization, never semantics."""
+        return int(self.raw.get("execution", {}).get("lanes", 0))
+
+    @property
     def trace_capacity(self) -> Optional[int]:
         """Flight-recorder ring capacity (events) for BOTH the Python span
         ring and the native engine rings. Optional and additive (no config

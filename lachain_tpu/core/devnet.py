@@ -69,6 +69,7 @@ class Devnet:
         kv_factory: Optional[Callable[[int], KVStore]] = None,
         pipeline_window: int = 0,
         journals: Optional[List] = None,
+        exec_lanes: int = 1,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
@@ -98,7 +99,10 @@ class Devnet:
             # full system-contract registry (deploy/LRC-20/governance/staking)
             # so the devnet exercises the same execution surface as a real node
             executer = system_contracts.make_executer(chain_id)
-            bm = BlockManager(kv, state, executer)
+            # exec_lanes=1 keeps devnet harnesses on the serial oracle by
+            # default; campaigns opt into lanes explicitly (results are
+            # bit-identical either way — core/parallel_exec.py)
+            bm = BlockManager(kv, state, executer, lanes=exec_lanes)
             bm.build_genesis(
                 self.initial_balances,
                 chain_id,
@@ -154,8 +158,13 @@ class Devnet:
             net_kw = dict(
                 fault_plan=fault_plan,
                 max_recovery_rounds=max_recovery_rounds,
-                journals=journals,
             )
+            if journals is not None:
+                # the python simulator has no journal hosting; passing one
+                # is a real request we cannot honor silently
+                raise ValueError(
+                    "consensus journals require engine='native'"
+                )
         self.net = net_cls(
             self.public_keys,
             self.private_keys,

@@ -68,6 +68,7 @@ class Node:
         advertise_host: Optional[str] = None,
         relay=None,  # "host:port:pubhex" or a list of them — NAT'd mode
         pipeline_window: int = 0,
+        exec_lanes: int = 0,
     ):
         self.index = index
         # era-pipelining lookahead (config blockchain.pipelineWindow). On a
@@ -97,6 +98,7 @@ class Node:
             self.kv,
             self.state,
             executer or system_contracts.make_executer(chain_id),
+            lanes=exec_lanes,
         )
         self.block_manager.build_genesis(
             dict(initial_balances or {}),

@@ -7,16 +7,49 @@ Parity with the reference's TransactionPool
     (401+; NonceCalculator.cs:21)
   * Restore from the persistent repo on startup (98+)
   * eviction of included/stale transactions
+
+Admission is SHARDED: the pool's maps are split across `_N_SHARDS`
+independent lock domains keyed by the sender address, so concurrent
+`add()` calls from the RPC/gossip ingest threads only serialize when two
+transactions share a sender shard. The expensive step — ECDSA sender
+recovery — runs OUTSIDE every lock. `txpool_admit_lock_wait_seconds`
+histograms the time an admitting thread spends blocked on its shard lock,
+which is the direct measure of residual admission contention.
+
+Lock ordering: shard lock -> `_nonce_lock` (state-trie nonce reads; the
+trie's LRU cache is not thread-safe). No path acquires two shard locks
+at once, so there is no cross-shard ordering to get wrong.
 """
 from __future__ import annotations
 
 import heapq
 import random
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..storage.kv import EntryPrefix, KVStore, prefixed
+from ..utils import metrics
 from .types import SignedTransaction
+
+_N_SHARDS = 16
+
+# shard-lock waits are sub-microsecond uncontended; buckets resolve the
+# interesting range (lock convoy under ingest bursts)
+_LOCK_WAIT_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1)
+
+
+class _PoolShard:
+    """One lock domain: the slice of the pool whose senders hash here."""
+
+    __slots__ = ("lock", "txs", "senders", "by_nonce")
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.txs: Dict[bytes, SignedTransaction] = {}
+        self.senders: Dict[bytes, bytes] = {}  # tx hash -> sender
+        # (sender, nonce) -> tx hash (reference TransactionHashTrackerByNonce)
+        self.by_nonce: Dict[Tuple[bytes, int], bytes] = {}
 
 
 class TransactionPool:
@@ -29,53 +62,69 @@ class TransactionPool:
     ):
         self._kv = kv
         self.chain_id = chain_id
-        self._account_nonce = account_nonce
+        self._account_nonce_fn = account_nonce
         self.min_gas_price = min_gas_price
-        self._lock = threading.RLock()
-        self._txs: Dict[bytes, SignedTransaction] = {}
-        self._senders: Dict[bytes, bytes] = {}  # tx hash -> sender
-        # (sender, nonce) -> tx hash (reference TransactionHashTrackerByNonce)
-        self._by_nonce: Dict[Tuple[bytes, int], bytes] = {}
+        self._shards = [_PoolShard() for _ in range(_N_SHARDS)]
+        # state-trie nonce reads go through the trie's LRU cache, which is
+        # not safe under concurrent mutation — serialize them
+        self._nonce_lock = threading.Lock()
+
+    def _shard(self, sender: bytes) -> _PoolShard:
+        return self._shards[sender[0] % _N_SHARDS]
+
+    def _account_nonce(self, sender: bytes) -> int:
+        with self._nonce_lock:
+            return self._account_nonce_fn(sender)
 
     def __len__(self) -> int:
-        return len(self._txs)
+        return sum(len(s.txs) for s in self._shards)
 
     # -- ingress --------------------------------------------------------------
     def precheck(self, stx: SignedTransaction) -> bool:
         """The cheap admission checks only (dedup + gas floor) — no
         signature recovery. Bulk-ingest callers filter through this BEFORE
         paying for batch sender recovery, so re-gossiped duplicates cost a
-        hash lookup, not an ECDSA recover."""
-        with self._lock:
-            return (
-                stx.hash() not in self._txs
-                and stx.tx.gas_price >= self.min_gas_price
-            )
+        hash lookup, not an ECDSA recover. Advisory by design (add()
+        re-checks under the shard lock), so the dict probes run lock-free."""
+        if stx.tx.gas_price < self.min_gas_price:
+            return False
+        h = stx.hash()
+        return all(h not in s.txs for s in self._shards)
 
     def add(self, stx: SignedTransaction) -> bool:
         """Verify + admit. Returns False (and drops) on any rule violation."""
         h = stx.hash()
-        with self._lock:
-            if h in self._txs:
-                return False
-            if stx.tx.gas_price < self.min_gas_price:
-                return False
-            sender = stx.sender(self.chain_id)
-            if sender is None:
+        if stx.tx.gas_price < self.min_gas_price:
+            return False
+        if any(h in s.txs for s in self._shards):
+            return False  # lock-free dedup; re-checked under the shard lock
+        # ECDSA recovery is the expensive step — outside every lock
+        sender = stx.sender(self.chain_id)
+        if sender is None:
+            return False
+        shard = self._shard(sender)
+        t0 = time.perf_counter()
+        with shard.lock:
+            metrics.observe_hist(
+                "txpool_admit_lock_wait_seconds",
+                time.perf_counter() - t0,
+                buckets=_LOCK_WAIT_BUCKETS,
+            )
+            if h in shard.txs:
                 return False
             current = self._account_nonce(sender)
             if stx.tx.nonce < current:
                 return False  # already used
             key = (sender, stx.tx.nonce)
-            if key in self._by_nonce:
+            if key in shard.by_nonce:
                 # replacement only for strictly higher fee
-                old = self._txs.get(self._by_nonce[key])
+                old = shard.txs.get(shard.by_nonce[key])
                 if old is not None and stx.tx.gas_price <= old.tx.gas_price:
                     return False
-                self._evict(self._by_nonce[key])
-            self._txs[h] = stx
-            self._senders[h] = sender
-            self._by_nonce[key] = h
+                self._evict_in_shard(shard, shard.by_nonce[key])
+            shard.txs[h] = stx
+            shard.senders[h] = sender
+            shard.by_nonce[key] = h
             # the pool's crash window: admitted to memory, not yet in the
             # crash-restore repository — a kill here loses the tx from the
             # restart (best-effort by design; gossip re-fills)
@@ -89,9 +138,10 @@ class TransactionPool:
     def next_nonce(self, sender: bytes) -> int:
         """Next usable nonce for `sender`: the account nonce advanced past
         any consecutive pending transactions already in the pool."""
-        with self._lock:
+        shard = self._shard(sender)
+        with shard.lock:
             nonce = self._account_nonce(sender)
-            while (sender, nonce) in self._by_nonce:
+            while (sender, nonce) in shard.by_nonce:
                 nonce += 1
             return nonce
 
@@ -149,6 +199,17 @@ class TransactionPool:
             max_txs, exclude=exclude, nonce_override=nonce_override
         )
 
+    def _snapshot(self) -> List[Tuple[bytes, bytes, SignedTransaction]]:
+        """(hash, sender, tx) triples — each shard copied under its own
+        lock, the union processed lock-free by the caller."""
+        out: List[Tuple[bytes, bytes, SignedTransaction]] = []
+        for shard in self._shards:
+            with shard.lock:
+                out.extend(
+                    (h, shard.senders[h], stx) for h, stx in shard.txs.items()
+                )
+        return out
+
     def _peek_ordered(
         self,
         max_txs: int,
@@ -168,64 +229,65 @@ class TransactionPool:
         exclude: Optional[Set[bytes]] = None,
         nonce_override: Optional[Dict[bytes, int]] = None,
     ) -> List[Tuple[bytes, SignedTransaction]]:
-        with self._lock:
-            per_sender: Dict[bytes, List[SignedTransaction]] = {}
-            for h, stx in self._txs.items():
-                if exclude is not None and h in exclude:
-                    continue  # claimed by an in-flight block
-                per_sender.setdefault(self._senders[h], []).append(stx)
-            # per-sender executable chains, nonce-ascending
-            chains: Dict[bytes, List[SignedTransaction]] = {}
-            for sender, txs in per_sender.items():
-                txs.sort(key=lambda t: t.tx.nonce)
-                if nonce_override is not None and sender in nonce_override:
-                    nonce = nonce_override[sender]
-                else:
-                    nonce = self._account_nonce(sender)
-                chain = []
-                for t in txs:
-                    if t.tx.nonce != nonce:
-                        break  # gap: later nonces are unexecutable
-                    chain.append(t)
-                    nonce += 1
-                if chain:
-                    chains[sender] = chain
-            # repeatedly take the highest-fee among the next-executable txs,
-            # so a cheap prerequisite nonce never strands an expensive later
-            # one (chain heads advance as they are picked). Heap keys are
-            # precomputed — one hash per tx, not per comparison.
-            def heap_key(stx: SignedTransaction):
-                h = stx.hash()
-                return (-stx.tx.gas_price, bytes(255 - b for b in h))
+        per_sender: Dict[bytes, List[SignedTransaction]] = {}
+        for h, sender, stx in self._snapshot():
+            if exclude is not None and h in exclude:
+                continue  # claimed by an in-flight block
+            per_sender.setdefault(sender, []).append(stx)
+        # per-sender executable chains, nonce-ascending
+        chains: Dict[bytes, List[SignedTransaction]] = {}
+        for sender, txs in per_sender.items():
+            txs.sort(key=lambda t: t.tx.nonce)
+            if nonce_override is not None and sender in nonce_override:
+                nonce = nonce_override[sender]
+            else:
+                nonce = self._account_nonce(sender)
+            chain = []
+            for t in txs:
+                if t.tx.nonce != nonce:
+                    break  # gap: later nonces are unexecutable
+                chain.append(t)
+                nonce += 1
+            if chain:
+                chains[sender] = chain
+        # repeatedly take the highest-fee among the next-executable txs,
+        # so a cheap prerequisite nonce never strands an expensive later
+        # one (chain heads advance as they are picked). Heap keys are
+        # precomputed — one hash per tx, not per comparison.
+        def heap_key(stx: SignedTransaction):
+            h = stx.hash()
+            return (-stx.tx.gas_price, bytes(255 - b for b in h))
 
-            picked: List[Tuple[bytes, SignedTransaction]] = []
-            heap = [(heap_key(chain[0]), s, 0) for s, chain in chains.items()]
-            heapq.heapify(heap)
-            while len(picked) < max_txs and heap:
-                _, s, i = heapq.heappop(heap)
-                picked.append((s, chains[s][i]))
-                if i + 1 < len(chains[s]):
-                    heapq.heappush(heap, (heap_key(chains[s][i + 1]), s, i + 1))
-            return picked
+        picked: List[Tuple[bytes, SignedTransaction]] = []
+        heap = [(heap_key(chain[0]), s, 0) for s, chain in chains.items()]
+        heapq.heapify(heap)
+        while len(picked) < max_txs and heap:
+            _, s, i = heapq.heappop(heap)
+            picked.append((s, chains[s][i]))
+            if i + 1 < len(chains[s]):
+                heapq.heappush(heap, (heap_key(chains[s][i + 1]), s, i + 1))
+        return picked
 
     # -- lifecycle --------------------------------------------------------------
     def remove_included(self, tx_hashes) -> None:
-        with self._lock:
-            for h in tx_hashes:
-                self._evict(h)
+        for h in tx_hashes:
+            self._evict(h)
 
     def sanitize(self) -> int:
         """Drop txs whose nonce is now stale (reference sanitize-on-persist,
         TransactionPool.cs:79-90). Returns number evicted."""
-        with self._lock:
-            stale = [
-                h
-                for h, stx in self._txs.items()
-                if stx.tx.nonce < self._account_nonce(self._senders[h])
-            ]
-            for h in stale:
-                self._evict(h)
-            return len(stale)
+        n = 0
+        for shard in self._shards:
+            with shard.lock:
+                stale = [
+                    h
+                    for h, stx in shard.txs.items()
+                    if stx.tx.nonce < self._account_nonce(shard.senders[h])
+                ]
+                for h in stale:
+                    self._evict_in_shard(shard, h)
+                n += len(stale)
+        return n
 
     def restore(self) -> int:
         """Reload persisted pool txs (reference Restore, TransactionPool.cs:98)."""
@@ -245,23 +307,38 @@ class TransactionPool:
         return count
 
     def _evict(self, h: bytes) -> None:
-        stx = self._txs.pop(h, None)
-        sender = self._senders.pop(h, None)
+        # hash alone does not name the shard — probe each, one lock at a
+        # time (never nested, so shard locks stay unordered)
+        for shard in self._shards:
+            with shard.lock:
+                if h in shard.txs:
+                    self._evict_in_shard(shard, h)
+                    return
+        self._kv.delete(prefixed(EntryPrefix.POOL_TX, h))
+
+    def _evict_in_shard(self, shard: _PoolShard, h: bytes) -> None:
+        """Caller holds shard.lock."""
+        stx = shard.txs.pop(h, None)
+        sender = shard.senders.pop(h, None)
         if stx is not None and sender is not None:
-            self._by_nonce.pop((sender, stx.tx.nonce), None)
+            shard.by_nonce.pop((sender, stx.tx.nonce), None)
         self._kv.delete(prefixed(EntryPrefix.POOL_TX, h))
 
     def tx_hashes(self) -> set:
         """Snapshot of pooled tx hashes (pending-tx filters)."""
-        with self._lock:
-            return set(self._txs)
+        out = set()
+        for shard in self._shards:
+            with shard.lock:
+                out.update(shard.txs)
+        return out
 
     def clear(self) -> None:
         """Drop every pooled tx, memory AND persisted entries (reference
         clearInMemoryPool + repository delete, TransactionPool.cs)."""
-        with self._lock:
-            for h in list(self._txs):
-                self._evict(h)
+        for shard in self._shards:
+            with shard.lock:
+                for h in list(shard.txs):
+                    self._evict_in_shard(shard, h)
 
     def persisted_hashes(self) -> List[bytes]:
         """Hashes of txs currently saved in the crash-restore repository."""
@@ -283,4 +360,8 @@ class TransactionPool:
         return n
 
     def get(self, h: bytes) -> Optional[SignedTransaction]:
-        return self._txs.get(h)
+        for shard in self._shards:
+            stx = shard.txs.get(h)
+            if stx is not None:
+                return stx
+        return None

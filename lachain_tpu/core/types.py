@@ -290,5 +290,20 @@ class Block:
         return self.header.hash()
 
 
+# header creation and execute_block's header check both derive the merkle
+# root over the same tx-hash list a few milliseconds apart; the pairwise
+# keccak tree is ~15ms at 10k txs, so memo the last few (FIFO like the
+# emulate memo; hashing the key tuple is ~30x cheaper than the tree)
+_MERKLE_MEMO: dict = {}
+_MERKLE_MEMO_MAX = 8
+
+
 def tx_merkle_root(tx_hashes: Sequence[bytes]) -> bytes:
-    return merkle_root(list(tx_hashes)) or ZERO_HASH
+    key = tuple(tx_hashes)
+    root = _MERKLE_MEMO.get(key)
+    if root is None:
+        root = merkle_root(list(key)) or ZERO_HASH
+        _MERKLE_MEMO[key] = root
+        while len(_MERKLE_MEMO) > _MERKLE_MEMO_MAX:
+            _MERKLE_MEMO.pop(next(iter(_MERKLE_MEMO)))
+    return root

@@ -74,12 +74,19 @@ class Snapshot:
     (the reference's Pending tier).
     """
 
+    # sentinel for "key was absent from the buffer" in undo entries —
+    # distinct from None, which is the buffered-delete marker
+    _ABSENT = object()
+
     def __init__(self, trie: Trie, roots: StateRoots):
         self._trie = trie
         self.base = roots
         self._writes: Dict[str, Dict[bytes, Optional[bytes]]] = {
             name: {} for name in SUBTREES
         }
+        # undo log for delta checkpoints: one (tree, key, prior-buffer-value)
+        # entry per buffer mutation; `checkpoint` is a position in this list
+        self._undo: List[Tuple[str, bytes, object]] = []
 
     # -- typed access --------------------------------------------------------
     def get(self, tree: str, key: bytes) -> Optional[bytes]:
@@ -89,10 +96,14 @@ class Snapshot:
         return self._trie.get(getattr(self.base, tree), key)
 
     def put(self, tree: str, key: bytes, value: bytes) -> None:
-        self._writes[tree][key] = value
+        buf = self._writes[tree]
+        self._undo.append((tree, key, buf.get(key, Snapshot._ABSENT)))
+        buf[key] = value
 
     def delete(self, tree: str, key: bytes) -> None:
-        self._writes[tree][key] = None
+        buf = self._writes[tree]
+        self._undo.append((tree, key, buf.get(key, Snapshot._ABSENT)))
+        buf[key] = None
 
     def freeze(self) -> StateRoots:
         """Flush buffered writes -> new immutable roots (Approve). Bulk
@@ -107,19 +118,35 @@ class Snapshot:
         return StateRoots(**new_roots)
 
     def discard(self) -> None:
-        """Rollback: drop buffered writes."""
+        """Rollback: drop buffered writes (outstanding checkpoints die too)."""
         for name in SUBTREES:
             self._writes[name].clear()
+        self._undo.clear()
 
-    def checkpoint(self) -> Dict[str, Dict[bytes, Optional[bytes]]]:
-        """Capture the write buffer for per-tx rollback (role of the
+    def checkpoint(self) -> int:
+        """Mark the current buffer state for per-tx rollback (role of the
         reference's per-tx snapshot/approve/rollback loop,
-        BlockManager.cs:371-560)."""
-        return {name: dict(self._writes[name]) for name in SUBTREES}
+        BlockManager.cs:371-560). O(1): the token is a position in the
+        undo log — the old implementation deep-copied every buffered tree
+        dict, which at 10k txs/block made per-tx checkpointing quadratic
+        in block size. Checkpoints are LIFO: restoring an older token
+        invalidates every younger one (both users — the per-tx loop in
+        core/execution.py and the per-frame VM rollback in vm/vm.py —
+        already nest strictly)."""
+        return len(self._undo)
 
-    def restore(self, cp: Dict[str, Dict[bytes, Optional[bytes]]]) -> None:
-        """Rewind the write buffer to a checkpoint."""
-        self._writes = {name: dict(cp[name]) for name in SUBTREES}
+    def restore(self, cp: int) -> None:
+        """Rewind the write buffer to a checkpoint token by popping the
+        undo log back to its position; cost is O(writes since the
+        checkpoint), not O(total buffered state)."""
+        undo = self._undo
+        writes = self._writes
+        while len(undo) > cp:
+            tree, key, prior = undo.pop()
+            if prior is Snapshot._ABSENT:
+                del writes[tree][key]
+            else:
+                writes[tree][key] = prior
 
 
 class StateManager:

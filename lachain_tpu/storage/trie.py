@@ -101,6 +101,9 @@ class Trie:
         self._cache: OrderedDict[bytes, object] = OrderedDict()
         self._cache_size = cache_size
         self._pending: Dict[bytes, bytes] = {}  # prefixed key -> encoding
+        # read-only view of a parent trie's node cache (see fork()); never
+        # mutated through this handle
+        self._read_cache: Optional[OrderedDict] = None
 
     # -- node io -------------------------------------------------------------
     def _store(self, node) -> bytes:
@@ -115,6 +118,15 @@ class Trie:
         if node is not None:
             self._cache.move_to_end(h)
             return node
+        if self._read_cache is not None:
+            # forked handle: peek the parent's cache WITHOUT touching its
+            # LRU order (move_to_end is what makes the parent cache unsafe
+            # to share between threads; a bare get is a single C-level dict
+            # read, and the parent thread is quiescent while forks run)
+            node = self._read_cache.get(h)
+            if node is not None:
+                self._cache_put(h, node)
+                return node
         key = prefixed(EntryPrefix.TRIE_NODE, h)
         enc = self._pending.get(key)
         if enc is None:
@@ -151,6 +163,19 @@ class Trie:
         Re-absorbing an already-persisted node is harmless — same key, same
         encoding — it just rides the next commit batch again."""
         self._pending.update(nodes)
+
+    def fork(self) -> "Trie":
+        """A private handle over the SAME kv for a concurrent reader
+        (parallel execution lanes): its own LRU cache and pending buffer
+        (seeded with ours — forked roots may reference not-yet-committed
+        nodes), plus a read-only peek into our cache so a fork does not
+        start cold. The fork is disposable: nodes it stores stay in its
+        own pending buffer and are simply dropped with it (lane-local
+        speculative state never rides a commit batch)."""
+        t = Trie(self._kv, self._cache_size)
+        t._pending = dict(self._pending)
+        t._read_cache = self._cache
+        return t
 
     def clear_cache(self) -> None:
         self._cache.clear()

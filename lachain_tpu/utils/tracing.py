@@ -407,16 +407,21 @@ PHASES = (
     "coin",
     "tpke_verify",
     "tpke_decrypt",
+    "exec",
     "commit",
 )
 _PHASE_PRIORITY = {
     "tpke_decrypt": 0,
     "tpke_verify": 1,
-    "propose": 2,
-    "commit": 3,
-    "coin": 4,
-    "ba": 5,
-    "rbc": 6,
+    # exec outranks commit: the block-execution span nests inside the
+    # root_produce commit crossing, and the refactored executor
+    # (core/parallel_exec.py) is what the exec column exists to expose
+    "exec": 2,
+    "propose": 3,
+    "commit": 4,
+    "coin": 5,
+    "ba": 6,
+    "rbc": 7,
 }
 
 # Python span name -> phase. Parent/orchestrator spans (era, HoneyBadger,
@@ -429,6 +434,7 @@ _SPAN_PHASE = {
     "CommonCoin": "coin",
     "hb.era_decrypt": "tpke_decrypt",
     "hb.apply_era_results": "tpke_decrypt",
+    "exec.block": "exec",
 }
 
 # Native crossing op name -> phase (see consensus/native_hosts.py XO_NAMES).

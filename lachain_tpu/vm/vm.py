@@ -9,6 +9,7 @@ host-import table (external.py).
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -31,26 +32,33 @@ MAX_FRAME_DEPTH = 16
 CODE_PREFIX = b"c:"  # 'contracts' subtree: code by address
 
 # decoded-module cache: Module objects are immutable after decode, so
-# repeated/nested invocations skip the binary re-parse (keyed by code hash)
+# repeated/nested invocations skip the binary re-parse (keyed by code hash).
+# Lock-guarded: parallel execution lanes (core/parallel_exec.py) decode
+# concurrently, and an unguarded move_to_end can race a sibling's eviction
 _MODULE_CACHE: "OrderedDict[bytes, object]" = None  # type: ignore[assignment]
 _MODULE_CACHE_MAX = 64
+_MODULE_CACHE_LOCK = threading.Lock()
 
 
 def _decode_cached(code: bytes):
     global _MODULE_CACHE
-    if _MODULE_CACHE is None:
-        from collections import OrderedDict
-
-        _MODULE_CACHE = OrderedDict()
     key = keccak256(code)
-    mod = _MODULE_CACHE.get(key)
-    if mod is None:
-        mod = decode_module(code)
+    with _MODULE_CACHE_LOCK:
+        if _MODULE_CACHE is None:
+            from collections import OrderedDict
+
+            _MODULE_CACHE = OrderedDict()
+        mod = _MODULE_CACHE.get(key)
+        if mod is not None:
+            _MODULE_CACHE.move_to_end(key)
+            return mod
+    # decode outside the lock (the expensive part); a racing duplicate
+    # decode yields an equivalent immutable Module — last store wins
+    mod = decode_module(code)
+    with _MODULE_CACHE_LOCK:
         _MODULE_CACHE[key] = mod
         if len(_MODULE_CACHE) > _MODULE_CACHE_MAX:
             _MODULE_CACHE.popitem(last=False)
-    else:
-        _MODULE_CACHE.move_to_end(key)
     return mod
 
 
