@@ -8,8 +8,8 @@ PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-storage \
-	test-observability test-sync test-pipeline test-exec native bench \
-	bench-gate
+	test-observability test-sync test-pipeline test-exec test-trie native \
+	bench bench-gate
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
@@ -63,6 +63,14 @@ test-sync:
 test-exec:
 	$(PYTEST) $(PYTEST_ARGS) -m exec
 
+# parallel merkleization: the 200-seed sharded-vs-serial apply_many
+# differential (roots + node sets + pending buffers), deferred batch
+# hashing, streamed-commit coverage. The slice to run after touching
+# storage/trie.py apply_many/_bulk, the batch keccak, or the
+# StateManager streamed commit
+test-trie:
+	$(PYTEST) $(PYTEST_ARGS) -m trie
+
 test:
 	$(PYTEST) $(PYTEST_ARGS)
 
@@ -89,3 +97,7 @@ bench-gate:
 		--pipeline-window 1 | tail -n 1 > /tmp/lachain_sim_now.json
 	python benchmarks/compare.py benchmarks/BENCH_sim_gate.json \
 		/tmp/lachain_sim_now.json --min-threshold-pct 40
+	python benchmarks/bench_storage_commit.py --engines lsm \
+		| tail -n 1 > /tmp/lachain_commit_now.json
+	python benchmarks/compare.py benchmarks/results_r10.json \
+		/tmp/lachain_commit_now.json --min-threshold-pct 25

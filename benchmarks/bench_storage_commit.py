@@ -71,7 +71,14 @@ def _make_txs(n_txs: int, chain: int):
     return txs, addrs
 
 
-def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
+def bench_engine(
+    engine: str,
+    txs,
+    addrs,
+    chain: int,
+    lanes: int = 0,
+    merkle_workers: int = 1,
+) -> dict:
     """One full commit-path measurement on a fresh store of `engine`."""
     from lachain_tpu.core import system_contracts
     from lachain_tpu.core.block_manager import BlockManager
@@ -83,6 +90,7 @@ def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
     from lachain_tpu.storage.kv import SqliteKV
     from lachain_tpu.storage.lsm import LsmKV
     from lachain_tpu.storage.state import StateManager
+    from lachain_tpu.storage.trie import resolve_merkle_workers
 
     with tempfile.TemporaryDirectory() as tmp:
         kv = (
@@ -91,14 +99,20 @@ def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
             else SqliteKV(os.path.join(tmp, "bench.db"))
         )
         state = StateManager(kv)
+        state.trie.merkle_workers = merkle_workers
         bm = BlockManager(kv, state, system_contracts.make_executer(chain))
         bm.build_genesis({a: 10**24 for a in addrs}, chain)
 
         ordered = bm.order_transactions(txs, chain)
         base = state.committed
+        # phase breakdown: emulate = execute txs + merkle freeze (the
+        # accumulated trie profile splits hash vs assemble); the commit
+        # leg is the fsynced persist (streamed WAL batches on lsm)
+        state.trie.reset_merkle_stats()
         t0 = time.perf_counter()
         em = bm.emulate(ordered, 1)
         t_emulate = time.perf_counter() - t0
+        mstats = dict(state.trie.merkle_stats)
         header = BlockHeader(
             index=1,
             prev_block_hash=bm.block_by_height(0).hash(),
@@ -109,6 +123,7 @@ def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
         t0 = time.perf_counter()
         bm.execute_block(header, ordered, MultiSig(()), check_state_hash=True)
         t_commit = time.perf_counter() - t0
+        cstats = dict(state.commit_stats)
         state_root = em.state_hash.hex()
 
         # raw fsynced batch throughput under the same store
@@ -143,6 +158,53 @@ def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
             raise SystemExit(
                 f"{engine}: differential base diverged from the block run"
             )
+
+        # serial-vs-sharded MERKLE differential over the SAME write-set:
+        # times only the freeze step and proves the sharded root equals
+        # the serial one (and the block run's) in the same process
+        def _exec_snap():
+            snap = state.new_snapshot(base)
+            for i, stx in enumerate(ordered):
+                bm.executer.execute(snap, stx, 1, i)
+            return snap
+
+        # three-way merkle differential over the SAME write-set —
+        # pre-PR-11 immediate per-node hashing (deferral floor pushed out
+        # of reach) vs deferred-batch serial vs sharded. Interleaved
+        # best-of-2 per mode, so cache warm-up from whichever leg runs
+        # first doesn't bias the comparison; every pass must produce the
+        # block run's root.
+        import lachain_tpu.storage.trie as trie_mod
+
+        n_merkle = max(resolve_merkle_workers(merkle_workers), 2)
+
+        def _freeze_once(immediate: bool, workers: int) -> float:
+            snap = _exec_snap()
+            saved_floor = trie_mod.MIN_DEFER_OPS
+            if immediate:
+                trie_mod.MIN_DEFER_OPS = 1 << 60
+            try:
+                t0 = time.perf_counter()
+                roots = snap.freeze(workers=workers)
+            finally:
+                trie_mod.MIN_DEFER_OPS = saved_floor
+            dt = time.perf_counter() - t0
+            if roots.state_hash() != em.state_hash:
+                raise SystemExit(
+                    f"{engine}: merkle differential root diverged "
+                    f"(immediate={immediate}, workers={workers})"
+                )
+            return dt
+
+        legs = [("immediate", True, 1), ("serial", False, 1),
+                ("sharded", False, n_merkle)]
+        best = {name: float("inf") for name, _, _ in legs}
+        for _ in range(2):
+            for name, immediate, workers in legs:
+                best[name] = min(best[name], _freeze_once(immediate, workers))
+        t_merkle_immediate = best["immediate"]
+        t_merkle_serial = best["serial"]
+        t_merkle_sharded = best["sharded"]
         kv.close()
 
     return {
@@ -153,6 +215,21 @@ def bench_engine(engine: str, txs, addrs, chain: int, lanes: int = 0) -> dict:
         "txs": len(txs),
         "emulate_s": round(t_emulate, 3),
         "tx_per_s_commit": round(len(txs) / t_commit, 1),
+        # commit-phase breakdown: tx execution vs merkleization (batched
+        # hashing vs walk/assembly; in sharded mode hash_s is aggregate
+        # worker CPU and may exceed the freeze wall) vs the WAL fsync
+        "exec_s": round(max(t_emulate - mstats.get("wall_s", 0.0), 0.0), 3),
+        "merkle_hash_s": round(mstats.get("hash_s", 0.0), 3),
+        "merkle_assemble_s": round(mstats.get("assemble_s", 0.0), 3),
+        "wal_fsync_s": round(cstats.get("wal_fsync_s", t_commit), 3),
+        "merkle_workers": int(mstats.get("workers", 1)),
+        "merkle_nodes": int(mstats.get("nodes", 0)),
+        "streamed_batches": int(cstats.get("streamed_batches", 0)),
+        "merkle_immediate_s": round(t_merkle_immediate, 3),
+        "merkle_serial_s": round(t_merkle_serial, 3),
+        "merkle_sharded_s": round(t_merkle_sharded, 3),
+        "merkle_sharded_workers": n_merkle,
+        "merkle_roots_identical": True,
         "exec_serial_s": round(t_serial_exec, 3),
         "exec_parallel_s": round(t_parallel_exec, 3),
         "exec_lanes": stats.lanes,
@@ -184,16 +261,33 @@ def main() -> None:
         help="parallel-execution lanes for the differential leg "
         "(0 = auto from cores, 1 = serial)",
     )
+    ap.add_argument(
+        "--merkle-workers",
+        type=int,
+        default=1,
+        help="merkleization workers for the block run (0 = auto from "
+        "cores, 1 = serial deferred-batch hashing); the merkle "
+        "differential leg always runs a >=2-worker sharded pass too",
+    )
     args = ap.parse_args()
 
     chain = 515
     txs, addrs = _make_txs(args.txs, chain)
     rows = [
-        bench_engine(e.strip(), txs, addrs, chain, lanes=args.lanes)
+        bench_engine(
+            e.strip(),
+            txs,
+            addrs,
+            chain,
+            lanes=args.lanes,
+            merkle_workers=args.merkle_workers,
+        )
         for e in args.engines.split(",")
         if e.strip()
     ]
-    out: dict = {"rows": rows}
+    # single-engine runs print the row itself so compare.py (which wants
+    # top-level metric/value) can gate it directly
+    out: dict = dict(rows[0]) if len(rows) == 1 else {"rows": rows}
     if len(rows) > 1:
         best = min(rows, key=lambda r: r["value"])
         rest = [r for r in rows if r is not best]

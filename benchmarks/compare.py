@@ -36,6 +36,12 @@ LATENCY_FIELDS = (
     "baseline_era_s",
     "per_node_normalized_latency_s",
     "fastsync_failover_recovery_s",
+    # bench_storage_commit phase breakdown (PR 11): compared only when
+    # both runs report them, so older baselines stay valid
+    "exec_s",
+    "merkle_hash_s",
+    "merkle_assemble_s",
+    "wal_fsync_s",
 )
 
 # throughput-shaped side fields compared higher-is-better when both runs

@@ -212,6 +212,7 @@ def _build_node(cfg, config_path=None):
         block_interval=cfg.blockchain.target_block_time_ms / 1000.0,
         pipeline_window=cfg.blockchain.pipeline_window,
         exec_lanes=cfg.execution_lanes,
+        merkle_workers=cfg.merkle_workers,
     )
     peers = []
     for spec in cfg.network.peers:

@@ -151,7 +151,10 @@ class BlockManager:
             event_addrs = tuple(
                 v[:20] for v in snap._writes["events"].values() if v
             )
-            roots = snap.freeze()
+            # merkle nests inside exec.block and outranks it in the phase
+            # report: commit attribution separates hashing from execution
+            with tracing.span("merkle.freeze", cat="merkle", era=block_index):
+                roots = snap.freeze()
         em = EmulationResult(
             roots=roots,
             state_hash=roots.state_hash(),

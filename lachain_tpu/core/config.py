@@ -257,6 +257,17 @@ class NodeConfig:
         return int(self.raw.get("execution", {}).get("lanes", 0))
 
     @property
+    def merkle_workers(self) -> int:
+        """Parallel-merkleization worker count (DEPLOY.md "Parallel
+        merkleization"). Optional and additive (no config version bump):
+        1 pins the serial walker (deferred batch hashing stays on), N > 1
+        fixes the subtrie worker count (capped at the 16-way fanout), 0
+        (the default) sizes workers from the host's cores. Every setting
+        produces bit-identical state roots — the knob only trades thread
+        overhead against core utilization."""
+        return int(self.raw.get("execution", {}).get("merkleWorkers", 0))
+
+    @property
     def trace_capacity(self) -> Optional[int]:
         """Flight-recorder ring capacity (events) for BOTH the Python span
         ring and the native engine rings. Optional and additive (no config

@@ -70,6 +70,7 @@ class Devnet:
         pipeline_window: int = 0,
         journals: Optional[List] = None,
         exec_lanes: int = 1,
+        merkle_workers: int = 1,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
@@ -103,6 +104,9 @@ class Devnet:
             # default; campaigns opt into lanes explicitly (results are
             # bit-identical either way — core/parallel_exec.py)
             bm = BlockManager(kv, state, executer, lanes=exec_lanes)
+            # like exec_lanes: devnet harnesses default to the serial
+            # merkle walker; campaigns opt in (roots identical either way)
+            state.trie.merkle_workers = merkle_workers
             bm.build_genesis(
                 self.initial_balances,
                 chain_id,

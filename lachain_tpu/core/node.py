@@ -69,6 +69,7 @@ class Node:
         relay=None,  # "host:port:pubhex" or a list of them — NAT'd mode
         pipeline_window: int = 0,
         exec_lanes: int = 0,
+        merkle_workers: int = 0,
     ):
         self.index = index
         # era-pipelining lookahead (config blockchain.pipelineWindow). On a
@@ -100,6 +101,9 @@ class Node:
             executer or system_contracts.make_executer(chain_id),
             lanes=exec_lanes,
         )
+        # parallel-merkleization knob (config execution.merkleWorkers):
+        # rides the shared trie handle so every freeze/commit sees it
+        self.state.trie.merkle_workers = merkle_workers
         self.block_manager.build_genesis(
             dict(initial_balances or {}),
             chain_id,

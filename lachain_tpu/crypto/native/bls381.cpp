@@ -2064,6 +2064,35 @@ extern "C" void lt_keccak256(const uint8_t *in, size_t inlen,
   keccak_sponge(out, 32, in, inlen, 136, 0x01);
 }
 
+// n keccak256 digests in one crossing: item i is data[offsets[i],
+// offsets[i+1]) (offsets has n+1 entries), out is n*32 bytes. The trie
+// commit hashes ~100k node encodings per 10k-tx block and per-call ctypes
+// dispatch dominates; same partitioning discipline as lt_g1_mul_batch,
+// GIL released by ctypes so worker threads overlap. returns 0 ok.
+extern "C" int lt_keccak256_batch(const uint8_t *data, const uint64_t *offsets,
+                                  size_t n, int nthreads, uint8_t *out) {
+  if (!data && n > 0 && offsets[n] > 0) return 1;
+  if (nthreads <= 1 || n < 64) {
+    for (size_t i = 0; i < n; i++)
+      keccak_sponge(out + i * 32, 32, data + offsets[i],
+                    (size_t)(offsets[i + 1] - offsets[i]), 136, 0x01);
+    return 0;
+  }
+  if ((size_t)nthreads > n / 2) nthreads = (int)(n / 2);
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    size_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    ts.emplace_back([&, lo, hi]() {
+      for (size_t i = lo; i < hi; i++)
+        keccak_sponge(out + i * 32, 32, data + offsets[i],
+                      (size_t)(offsets[i + 1] - offsets[i]), 136, 0x01);
+    });
+  }
+  for (auto &th : ts) th.join();
+  return 0;
+}
+
 // xof(domain, data, n) — must match the oracle: shake256(len(dom)||dom||data)
 static void xof(uint8_t *out, size_t outlen, const uint8_t *dom, size_t domlen,
                 const uint8_t *data, size_t datalen) {

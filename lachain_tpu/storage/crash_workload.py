@@ -120,19 +120,94 @@ def run_workload(
     }
 
 
+STREAM_BLOCKS = 2
+STREAM_TXS = 120
+
+
+def run_stream_workload(
+    kv, blocks: int = STREAM_BLOCKS, chain_id: int = DEFAULT_CHAIN_ID
+) -> dict:
+    """Streamed-commit variant for the trie.merkle.subtree_streamed crash
+    window: many-tx blocks over a LOWERED stream threshold, so every block
+    commit ships its trie nodes as multiple async WAL batches before the
+    root record (the PR 11 fsync-overlap path). Deterministic and
+    resume-friendly like run_workload; kept separate so its extra batch
+    traffic never shifts the classic matrix's traversal counts."""
+    from ..core import execution
+    from ..core.block_manager import BlockManager
+    from ..core.types import (
+        BlockHeader,
+        MultiSig,
+        Transaction,
+        sign_transaction,
+        tx_merkle_root,
+    )
+    from ..crypto import ecdsa
+    from .state import StateManager
+
+    priv = ecdsa.generate_private_key(_Rng(7))
+    sender = ecdsa.address_from_public_key(ecdsa.public_key_bytes(priv))
+
+    state = StateManager(kv)
+    state.stream_threshold = 64
+    state._STREAM_BATCH = 100
+    state.trie.merkle_workers = 4
+    bm = BlockManager(kv, state, execution.TransactionExecuter(chain_id))
+    bm.build_genesis({sender: 10**18}, chain_id)
+
+    start = bm.current_height() + 1
+    for height in range(start, blocks + 1):
+        txs = [
+            sign_transaction(
+                Transaction(
+                    to=b"\x37" * 12 + i.to_bytes(8, "big"),
+                    value=height,
+                    nonce=(height - 1) * STREAM_TXS + i,
+                    gas_price=1,
+                    gas_limit=100_000,
+                ),
+                priv,
+                chain_id,
+            )
+            for i in range(STREAM_TXS)
+        ]
+        em = bm.emulate(txs, height)
+        prev = bm.block_by_height(height - 1)
+        header = BlockHeader(
+            index=height,
+            prev_block_hash=prev.hash(),
+            merkle_root=tx_merkle_root([t.hash() for t in txs]),
+            state_hash=em.state_hash,
+            nonce=0,
+        )
+        bm.execute_block(header, txs, MultiSig(()))
+    return {
+        "height": bm.current_height(),
+        "root": state.committed.state_hash().hex(),
+        "streamed": state.commit_stats.get("streamed_batches", 0),
+    }
+
+
 def main(argv) -> int:
     """Subprocess entry: arm from LACHAIN_CRASH_POINTS, run, print stats.
     A sigkill plan never reaches the print — the parent observes -SIGKILL
-    and inspects the torn database."""
+    and inspects the torn database. `DB ENGINE stream` runs the streamed-
+    commit workload instead of the classic matrix one."""
     from . import crashpoints
 
     db_path = argv[0]
     engine = argv[1] if len(argv) > 1 else "sqlite"
-    blocks = int(argv[2]) if len(argv) > 2 else DEFAULT_BLOCKS
+    stream = len(argv) > 2 and argv[2] == "stream"
+    blocks = (
+        int(argv[2]) if len(argv) > 2 and not stream else DEFAULT_BLOCKS
+    )
     crashpoints.arm_from_env()
     kv = open_kv(db_path, engine)
     try:
-        stats = run_workload(kv, blocks=blocks)
+        if stream:
+            stats = run_stream_workload(kv)
+        else:
+            stats = run_workload(kv, blocks=blocks)
     finally:
         kv.close()
     print(json.dumps(stats))

@@ -45,6 +45,12 @@ Instrumented point names:
   lsm.compact.mid                     LsmKV only: merged SST renamed into
                                       place, manifest swap lost — open()
                                       sweeps the orphan
+  trie.merkle.subtree_streamed        streamed trie commit (StateManager):
+                                      after an async subtrie node batch is
+                                      enqueued on the WAL writer, before
+                                      the root record — leaves durable
+                                      orphan nodes with no referencing
+                                      root; fsck-clean, replay recommits
 
 The lsm.* sites leave REAL torn native state (lsm.py calls the engine's
 partial-execution debug APIs before dying), identical bytes on disk in

@@ -52,6 +52,13 @@ def prefixed(prefix: EntryPrefix, key: bytes = b"") -> bytes:
 class KVStore:
     """Interface (reference IRocksDbContext shape)."""
 
+    # True when write_batch_async genuinely overlaps WAL encode/fsync with
+    # the caller's continued work (the LSM engine); the default emulation
+    # below just runs the batch synchronously, so callers gate streamed
+    # commits on this flag instead of paying batch-splitting overhead for
+    # nothing.
+    supports_async_batches = False
+
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
@@ -64,6 +71,24 @@ class KVStore:
     def write_batch(self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()) -> None:
         """Atomic multi-write (reference RocksDBAtomicWrite.cs:1-39)."""
         raise NotImplementedError
+
+    def write_batch_async(
+        self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()
+    ):
+        """Submit an atomic batch WITHOUT waiting for durability; returns a
+        ticket for write_barrier. Engines whose WAL runs on its own writer
+        thread (LSM) overlap the batch's encode+fsync with the caller's
+        next work — the fsync-overlap seam of the streamed trie commit.
+        Default: synchronous write_batch (ticket None)."""
+        self.write_batch(puts, deletes)
+        return None
+
+    def write_barrier(self, ticket) -> None:
+        """Block until the write_batch_async ticket's batch is durable.
+        Engines with an append-ordered WAL may treat any LATER durable
+        write as an implicit barrier for earlier tickets; callers must
+        still issue the barrier before acking state that references the
+        async batches. Default: no-op (batches were synchronous)."""
 
     def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
         raise NotImplementedError

@@ -106,6 +106,12 @@ def _load_lib():
     lib.lsm_write_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
     ]
+    lib.lsm_write_batch_async.restype = ctypes.c_uint64
+    lib.lsm_write_batch_async.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.lsm_write_barrier.restype = ctypes.c_int
+    lib.lsm_write_barrier.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.lsm_write_batch_partial.restype = ctypes.c_int
     lib.lsm_write_batch_partial.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
@@ -144,7 +150,7 @@ def _load_lib():
     lib.lsm_table_count.restype = ctypes.c_uint64
     lib.lsm_table_count.argtypes = [ctypes.c_void_p]
     lib.lsm_version.restype = ctypes.c_int
-    assert lib.lsm_version() == 4
+    assert lib.lsm_version() == 5
     lib.lsm_monotonic_ns.restype = ctypes.c_uint64
     lib.lsm_monotonic_ns.argtypes = []
     lib.lsm_trace_configure.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -178,6 +184,10 @@ def _encode_batch(
 
 class LsmKV(KVStore):
     """Durable KV on the native LSM engine (drop-in for SqliteKV)."""
+
+    # WAL runs on its own writer thread -> write_batch_async genuinely
+    # overlaps the record's encode+fsync with the caller's next work
+    supports_async_batches = True
 
     def __init__(
         self,
@@ -348,6 +358,40 @@ class LsmKV(KVStore):
         # no .mid point: the batch commits inside one native call — the
         # torn-WAL windows are the lsm.wal.* sites above
         crash_point("kv.write_batch.post")
+
+    def write_batch_async(
+        self, puts: List[Tuple[bytes, bytes]], deletes: List[bytes] = ()
+    ) -> int:
+        """Enqueue an atomic batch onto the WAL writer thread WITHOUT
+        waiting for its fsync; returns the WAL seq as the barrier ticket.
+        The streamed trie commit pipelines through this: chunk N+1's
+        Python-side encode overlaps chunk N's write()+fsync(). A crash
+        before the barrier can leave these batches durable but unacked —
+        callers must only stream data that is SAFE to persist early
+        (content-addressed trie nodes: orphans without a root record,
+        fsck-clean, shrink reclaims them).
+
+        Deliberately NOT a crash_point/torn-site surface: the generic
+        kv.write_batch.* sites use traversal counts as matrix coordinates,
+        and streamed chunks would shift every existing hit number. The
+        mid-stream window has its own dedicated point
+        (trie.merkle.subtree_streamed) in StateManager."""
+        payload = _encode_batch(list(puts), list(deletes))
+        with self._lock:
+            seq = self._lib.lsm_write_batch_async(
+                self._h, payload, len(payload)
+            )
+        if seq == 0:
+            raise IOError("LSM write_batch_async failed")
+        return int(seq)
+
+    def write_barrier(self, ticket) -> None:
+        """Block until the ticketed async batch's WAL record is fsynced."""
+        if not ticket:
+            return
+        with self._lock:
+            if self._lib.lsm_write_barrier(self._h, int(ticket)) != 0:
+                raise IOError("LSM write_barrier failed")
 
     def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
         buf = ctypes.POINTER(ctypes.c_ubyte)()

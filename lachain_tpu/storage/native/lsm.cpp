@@ -1172,6 +1172,42 @@ struct Lsm {
     return wal_wait(seq) ? 0 : -1;
   }
 
+  // write_batch minus the durability wait: enqueue onto the writer thread,
+  // splice the memtable, return the WAL seq as an async ticket. The caller
+  // overlaps its next work (more trie hashing, the next batch's encode)
+  // with this record's write()+fsync(), then collects durability via
+  // write_barrier before acking anything that references the batch. The
+  // WAL is append-ordered, so a later record's fsync implies this one's.
+  // Returns 0 on failure (seqs start at 1).
+  u64 write_batch_async(const u8* payload, size_t len) {
+    auto* copy = new std::string((const char*)payload, len);
+    std::vector<OpView> ops;
+    if (!parse_batch_views((const u8*)copy->data(), copy->size(), ops)) {
+      delete copy;
+      return 0;
+    }
+    u64 seq;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      if (io_failed) {
+        delete copy;
+        return 0;
+      }
+      seq = wal_enqueue_locked(payload, len);
+      {
+        std::lock_guard<std::mutex> g(wal_mu);
+        stats.wal_records++;
+      }
+      mem->ingest(copy, ops);
+      if (mem->bytes >= flush_threshold) {
+        if (!seal_memtable(lk)) return 0;
+      }
+    }
+    return seq;
+  }
+
+  int write_barrier(u64 seq) { return wal_wait(seq) ? 0 : -1; }
+
   // Debug crash surface: run the write pipeline only up to `stage`, never
   // applying the memtable — the torn windows the crash matrix needs.
   //   stage 0 ("encoded, not fsynced"): a PREFIX of the record reaches the
@@ -1725,6 +1761,14 @@ int lsm_write_batch(void* h, const u8* payload, size_t len) {
   return static_cast<Lsm*>(h)->write_batch(payload, len);
 }
 
+u64 lsm_write_batch_async(void* h, const u8* payload, size_t len) {
+  return static_cast<Lsm*>(h)->write_batch_async(payload, len);
+}
+
+int lsm_write_barrier(void* h, u64 seq) {
+  return static_cast<Lsm*>(h)->write_barrier(seq);
+}
+
 int lsm_write_batch_partial(void* h, const u8* payload, size_t len,
                             int stage) {
   return static_cast<Lsm*>(h)->write_batch_partial(payload, len, stage);
@@ -1851,6 +1895,6 @@ u64 lsm_trace_drain(void* h, u8* buf, u64 cap) {
   return out.size();
 }
 
-int lsm_version() { return 4; }
+int lsm_version() { return 5; }
 
 }  // extern "C"

@@ -15,16 +15,36 @@ makes rollback O(1). An LRU node cache fills the role of TrieHashMap's cache.
 """
 from __future__ import annotations
 
+import os
+import time
 from collections import OrderedDict
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
-from ..crypto.hashes import keccak256
+from ..crypto.hashes import keccak256, keccak256_batch
 from ..utils.serialization import Reader, write_bytes, write_u16, write_u32
 from .kv import EntryPrefix, KVStore, prefixed
 
 EMPTY_ROOT = b"\x00" * 32
 _NIBBLES = 64  # keccak256 -> 64 nibbles
+
+# batch-size floors for the two merkleization fast paths: below them the
+# bookkeeping costs more than the per-node keccak dispatch it saves
+MIN_DEFER_OPS = 32    # deferred level-batched hashing
+MIN_SHARD_OPS = 512   # subtrie-sharded workers
+
+_KECCAK_BATCH_BUCKETS = (16, 64, 256, 1024, 4096, 16384, 65536)
+
+
+def resolve_merkle_workers(n: int) -> int:
+    """Merkle worker knob -> effective count: 0 = auto (host cores, capped
+    at the 16-way subtrie fanout), N pins it. 1 disables sharding but
+    keeps deferred batch hashing (the single-core win)."""
+    n = int(n)
+    if n > 0:
+        return min(n, 16)
+    return min(os.cpu_count() or 1, 16)
 
 
 def _nibble(h: bytes, depth: int) -> int:
@@ -85,6 +105,63 @@ def _decode(data: bytes):
     raise ValueError("bad trie node encoding")
 
 
+class _DeferredHasher:
+    """Deferred-hash node sink for bulk merkleization: while armed on a
+    Trie, `_store` hands out a 9-byte placeholder token instead of hashing
+    the node. `Trie._resolve_deferred` then encodes the accumulated nodes
+    level-by-level bottom-up, hashes each level's encodings in ONE native
+    batch call (crypto.hashes.keccak256_batch) and patches child
+    references — collapsing ~one Python→C keccak crossing per node into
+    one per tree level (~6 for a 100k-node block).
+
+    Token contract (what keeps `_bulk`'s no-op short-circuits and the
+    collapse rules bit-identical to the immediate-hash path): a token is
+    never equal to a real 32-byte hash, to EMPTY_ROOT, or to a different
+    token, and tokens are handed out only for genuinely stored nodes — so
+    `children == list(node.children)` still means exactly "nothing changed
+    under this branch"."""
+
+    __slots__ = ("nodes", "levels", "buckets", "count")
+    PREFIX = 0xFE
+
+    def __init__(self):
+        self.nodes: Dict[bytes, object] = {}  # token -> node (for _load)
+        self.levels: Dict[bytes, int] = {}  # token -> bottom-up level
+        # per-level (tokens, nodes) parallel lists — the batch-hash units
+        self.buckets: List[Tuple[List[bytes], List[object]]] = []
+        self.count = 0
+
+    def store(self, node) -> bytes:
+        # HOT: once per stored node. The level is known right here —
+        # children are always stored before their parent — so computing
+        # it now saves _resolve_deferred a whole extra pass. b"\xfe" ==
+        # PREFIX inlined; token child refs are the only 9-byte refs.
+        token = b"\xfe" + self.count.to_bytes(8, "big")
+        self.count += 1
+        lvl = 0
+        if type(node) is InternalNode:
+            levels = self.levels
+            for c in node.children:
+                if len(c) == 9:
+                    cl = levels[c]
+                    if cl >= lvl:
+                        lvl = cl + 1
+        self.levels[token] = lvl
+        self.nodes[token] = node
+        buckets = self.buckets
+        if lvl >= len(buckets):  # parents are at most one level above
+            buckets.append(([], []))
+        bt, bn = buckets[lvl]
+        bt.append(token)
+        bn.append(node)
+        return token
+
+    @staticmethod
+    def is_token(h: bytes) -> bool:
+        # real node hashes are 32 bytes; tokens are 9
+        return len(h) == 9 and h[0] == _DeferredHasher.PREFIX
+
+
 class Trie:
     """Handle over a KV store; every mutation returns a NEW root hash.
 
@@ -104,9 +181,22 @@ class Trie:
         # read-only view of a parent trie's node cache (see fork()); never
         # mutated through this handle
         self._read_cache: Optional[OrderedDict] = None
+        # read-only view of a parent trie's PENDING buffer (_shard_fork):
+        # a shard worker starts with an empty buffer of its own, so its
+        # new nodes are exactly `_pending` after the run — no diffing
+        self._read_pending: Optional[Dict[bytes, bytes]] = None
+        # armed deferred-hash sink (apply_many bulk paths only)
+        self._defer: Optional[_DeferredHasher] = None
+        # merkle worker knob (config execution.merkleWorkers): 0 = auto
+        self.merkle_workers: int = 0
+        # accumulated apply_many profile (reset_merkle_stats() to zero),
+        # for the commit-phase bench breakdown
+        self.merkle_stats: Dict[str, float] = {}
 
     # -- node io -------------------------------------------------------------
     def _store(self, node) -> bytes:
+        if self._defer is not None:
+            return self._defer.store(node)
         enc = node.encode()
         h = keccak256(enc)
         self._pending[prefixed(EntryPrefix.TRIE_NODE, h)] = enc
@@ -114,6 +204,8 @@ class Trie:
         return h
 
     def _load(self, h: bytes):
+        if self._defer is not None and _DeferredHasher.is_token(h):
+            return self._defer.nodes[h]
         node = self._cache.get(h)
         if node is not None:
             self._cache.move_to_end(h)
@@ -129,6 +221,8 @@ class Trie:
                 return node
         key = prefixed(EntryPrefix.TRIE_NODE, h)
         enc = self._pending.get(key)
+        if enc is None and self._read_pending is not None:
+            enc = self._read_pending.get(key)
         if enc is None:
             enc = self._kv.get(key)
         if enc is None:
@@ -175,6 +269,17 @@ class Trie:
         t = Trie(self._kv, self._cache_size)
         t._pending = dict(self._pending)
         t._read_cache = self._cache
+        return t
+
+    def _shard_fork(self) -> "Trie":
+        """A worker handle for subtrie-sharded merkleization: like fork(),
+        but the pending buffer starts EMPTY and chains read-only over ours
+        (copying 100k inherited entries per worker would eat the win). The
+        worker's newly stored nodes are exactly its `_pending`, which the
+        caller absorbs — unlike lane forks, shard results are canonical."""
+        t = Trie(self._kv, self._cache_size)
+        t._read_cache = self._cache
+        t._read_pending = self._pending
         return t
 
     def clear_cache(self) -> None:
@@ -260,16 +365,213 @@ class Trie:
     # the block-commit hot path: at N=64 the per-key replay was ~18% of the
     # whole simulated era.
 
-    def apply_many(self, root: bytes, writes: Dict[bytes, Optional[bytes]]) -> bytes:
+    def apply_many(
+        self,
+        root: bytes,
+        writes: Dict[bytes, Optional[bytes]],
+        workers: Optional[int] = None,
+        stream: Optional[Callable[[List[Tuple[bytes, bytes]]], None]] = None,
+    ) -> bytes:
         """Apply a {key: value-or-None(delete)} batch; returns the new root
-        (bit-identical to sequential put/delete in any order)."""
+        (bit-identical to sequential put/delete in any order, for any
+        worker count).
+
+        Large batches take one of two fast paths, both exact:
+          * deferred batch hashing (>= MIN_DEFER_OPS): nodes are encoded
+            level-by-level bottom-up and each level is hashed in one
+            threaded native keccak call;
+          * subtrie sharding (>= MIN_SHARD_OPS and workers > 1): the op
+            batch splits by top-level nibble, each subtrie runs on a
+            worker over a _shard_fork() handle, and the root is assembled
+            from the 16 child hashes on the caller thread.
+
+        `workers` overrides the handle's merkle_workers knob (0 = auto).
+        `stream`, when given, receives each completed subtrie's NEW
+        (key, encoding) node items as workers finish — the fsync-overlap
+        seam StateManager.freeze_and_commit plugs the WAL into."""
         if not writes:
             return root
         entries: Dict[bytes, Optional[bytes]] = {
             keccak256(k): v for k, v in writes.items()
         }
         ops = sorted(entries.items())
-        return self._bulk(root, ops, 0)
+        nworkers = resolve_merkle_workers(
+            self.merkle_workers if workers is None else workers
+        )
+        t0 = time.perf_counter()
+        if nworkers > 1 and len(ops) >= MIN_SHARD_OPS and root != EMPTY_ROOT:
+            node = self._load(root)
+            if isinstance(node, InternalNode):
+                return self._apply_sharded(
+                    root, node, ops, nworkers, stream, t0
+                )
+        return self._apply_serial(root, ops, nworkers, stream, t0)
+
+    def _apply_serial(self, root, ops, nworkers, stream, t0) -> bytes:
+        """Single-walker bulk application; defers hashing into per-level
+        native batch calls when the batch is big enough to pay for it."""
+        if len(ops) < MIN_DEFER_OPS:
+            new_root = self._bulk(root, ops, 0)
+            self._set_merkle_stats(t0, 0.0, 0, 1)
+            return new_root
+        self._defer = _DeferredHasher()
+        try:
+            out = self._bulk(root, ops, 0)
+        finally:
+            defer, self._defer = self._defer, None
+        resolved, hash_s, items = self._resolve_deferred(defer, nworkers)
+        if _DeferredHasher.is_token(out):
+            out = resolved[out]
+        if stream is not None and items:
+            stream(items)
+        self._set_merkle_stats(t0, hash_s, len(items), 1)
+        return out
+
+    def _apply_sharded(
+        self, root_hash, node, ops, nworkers, stream, t0
+    ) -> bytes:
+        """Subtrie-sharded merkleization over the 16-way top-level fanout.
+        Each worker owns an independent subtrie (disjoint key ranges), so
+        its node set is canonical regardless of scheduling; the caller
+        thread replays the serial path's depth-0 step — per-nibble child
+        patch, no-op short-circuit, collapse rule — over the 16 child
+        hashes, which is what makes the root bit-identical to `_bulk`."""
+        groups = _group_by_nibble(ops, 0)
+        children = list(node.children)
+
+        def run(nib: int, group) -> tuple:
+            fork = self._shard_fork()
+            fork._defer = _DeferredHasher()
+            try:
+                sub = fork._bulk(children[nib], group, 1)
+            finally:
+                defer, fork._defer = fork._defer, None
+            # per-worker native hashing stays single-threaded: the
+            # parallelism budget is already spent on the worker pool
+            resolved, hash_s, items = fork._resolve_deferred(defer, 1)
+            if _DeferredHasher.is_token(sub):
+                sub = resolved[sub]
+            return nib, sub, items, hash_s
+
+        results: Dict[int, bytes] = {}
+        hash_s = 0.0
+        hashed = 0
+        with ThreadPoolExecutor(
+            max_workers=min(nworkers, len(groups)),
+            thread_name_prefix="merkle",
+        ) as pool:
+            futs = [
+                pool.submit(run, nib, group)
+                for nib, group in sorted(groups.items())
+            ]
+            # absorb/stream in COMPLETION order: a finished subtrie's node
+            # batch can hit the WAL while its siblings are still hashing
+            pending_futs = set(futs)
+            while pending_futs:
+                done, pending_futs = wait(
+                    pending_futs, return_when=FIRST_EXCEPTION
+                )
+                for fut in done:
+                    nib, sub, items, worker_hash_s = fut.result()
+                    results[nib] = sub
+                    self._pending.update(items)
+                    hash_s += worker_hash_s
+                    hashed += len(items)
+                    if stream is not None and items:
+                        stream(items)
+        for nib in groups:
+            children[nib] = results[nib]
+        if children == list(node.children):
+            out = root_hash
+        else:
+            out = self._collapse_or_store(children)
+        self._set_merkle_stats(t0, hash_s, hashed, min(nworkers, len(groups)))
+        return out
+
+    def _resolve_deferred(
+        self, defer: _DeferredHasher, nthreads: int
+    ) -> Tuple[Dict[bytes, bytes], float, List[Tuple[bytes, bytes]]]:
+        """Hash a deferred sink's nodes level-by-level bottom-up through
+        the native batch keccak, patching child tokens with the hashes of
+        the level below. Returns (token -> hash, seconds spent hashing,
+        new (prefixed key, encoding) items stored).
+
+        HOT PATH: ~one iteration per node per 10k-tx block commit. Token
+        tests are inlined as `len(c) == 9` (real child refs are always 32
+        bytes) and leaves — the bulk of every batch — skip the patch
+        machinery entirely; the Python bookkeeping here must stay well
+        under the per-node ctypes crossing it saves, or deferral is a
+        net loss at merkle_workers=1."""
+        resolved: Dict[bytes, bytes] = {}
+        items: List[Tuple[bytes, bytes]] = []
+        hash_s = 0.0
+        trie_node = int(EntryPrefix.TRIE_NODE).to_bytes(2, "big")
+        pending = self._pending
+        cache = self._cache
+        from ..utils import metrics
+
+        for tokens, bnodes in defer.buckets:
+            patched: List[object] = []
+            for n in bnodes:
+                if type(n) is InternalNode:
+                    ch = n.children
+                    for c in ch:
+                        if len(c) == 9:
+                            n = InternalNode(
+                                tuple(
+                                    [
+                                        resolved[c] if len(c) == 9 else c
+                                        for c in ch
+                                    ]
+                                )
+                            )
+                            break
+                patched.append(n)
+            encs = [n.encode() for n in patched]
+            h0 = time.perf_counter()
+            hashes = keccak256_batch(encs, nthreads)
+            hash_s += time.perf_counter() - h0
+            metrics.observe_hist(
+                "trie_keccak_batch_size",
+                len(encs),
+                buckets=_KECCAK_BATCH_BUCKETS,
+            )
+            # bulk C-level stores instead of a per-node interpreted loop
+            keys = [trie_node + h for h in hashes]
+            pairs = list(zip(keys, encs))
+            pending.update(pairs)
+            items.extend(pairs)
+            resolved.update(zip(tokens, hashes))
+            cache.update(zip(hashes, patched))
+        # one bulk trim instead of per-put LRU churn (_cache_put does a
+        # move_to_end + popitem dance per node; recency inside one batch
+        # is meaningless anyway)
+        while len(cache) > self._cache_size:
+            cache.popitem(last=False)
+        return resolved, hash_s, items
+
+    def reset_merkle_stats(self) -> None:
+        """Zero the accumulated apply_many profile (bench phase breakdowns
+        call this before a timed section so the totals cover exactly it)."""
+        self.merkle_stats = {}
+
+    def _set_merkle_stats(
+        self, t0: float, hash_s: float, nodes: int, workers: int
+    ) -> None:
+        # ACCUMULATES across apply_many calls: a Snapshot.freeze applies
+        # one batch per subtree, and the commit-phase breakdown wants the
+        # whole-freeze totals, not the last subtree's
+        wall = time.perf_counter() - t0
+        st = self.merkle_stats
+        st["wall_s"] = st.get("wall_s", 0.0) + wall
+        st["hash_s"] = st.get("hash_s", 0.0) + hash_s
+        st["assemble_s"] = st.get("assemble_s", 0.0) + max(wall - hash_s, 0.0)
+        st["nodes"] = int(st.get("nodes", 0)) + nodes
+        st["workers"] = max(int(st.get("workers", 0)), workers)
+        from ..utils import metrics
+
+        metrics.inc("trie_nodes_hashed_total", nodes)
+        metrics.set_gauge("trie_merkle_workers", workers)
 
     def _bulk(self, node_hash: bytes, ops, depth: int) -> bytes:
         if not ops:

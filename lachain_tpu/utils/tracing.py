@@ -408,20 +408,24 @@ PHASES = (
     "tpke_verify",
     "tpke_decrypt",
     "exec",
+    "merkle",
     "commit",
 )
 _PHASE_PRIORITY = {
     "tpke_decrypt": 0,
     "tpke_verify": 1,
+    # merkle outranks exec: the merkle.freeze span nests inside exec.block,
+    # and commit attribution must separate hashing from tx execution.
     # exec outranks commit: the block-execution span nests inside the
     # root_produce commit crossing, and the refactored executor
     # (core/parallel_exec.py) is what the exec column exists to expose
-    "exec": 2,
-    "propose": 3,
-    "commit": 4,
-    "coin": 5,
-    "ba": 6,
-    "rbc": 7,
+    "merkle": 2,
+    "exec": 3,
+    "propose": 4,
+    "commit": 5,
+    "coin": 6,
+    "ba": 7,
+    "rbc": 8,
 }
 
 # Python span name -> phase. Parent/orchestrator spans (era, HoneyBadger,
@@ -435,6 +439,7 @@ _SPAN_PHASE = {
     "hb.era_decrypt": "tpke_decrypt",
     "hb.apply_era_results": "tpke_decrypt",
     "exec.block": "exec",
+    "merkle.freeze": "merkle",
 }
 
 # Native crossing op name -> phase (see consensus/native_hosts.py XO_NAMES).

@@ -14,7 +14,11 @@ import sys
 import pytest
 
 from lachain_tpu.storage import crashpoints
-from lachain_tpu.storage.crash_workload import open_kv, run_workload
+from lachain_tpu.storage.crash_workload import (
+    open_kv,
+    run_stream_workload,
+    run_workload,
+)
 from lachain_tpu.storage.crashpoints import (
     CrashPlan,
     CrashPoint,
@@ -264,6 +268,118 @@ def test_subprocess_sigkill_torn_block(tmp_path, engine):
         assert stats["height"] == 6
     finally:
         kv.close()
+
+
+# -- streamed-commit mid-stream crashes (PR 11 fsync overlap) ---------------
+#
+# run_stream_workload drives the REAL block pipeline (genesis + two
+# 120-tx blocks) over a lowered stream threshold, so every block commit
+# ships its trie nodes as multiple async WAL batches before the
+# root-referencing record. trie.merkle.subtree_streamed fires once per
+# streamed batch: block 1's commit is hits 1-4, block 2's hits 5-8
+# (genesis stays under the threshold). Because the block batch is durable
+# before state.commit starts, a mid-stream crash presents as the classic
+# repairable orphan-block tear — the streamed trie nodes themselves are
+# unreferenced orphans fsck must treat as invisible, and NEVER as a
+# committed root with missing nodes.
+
+
+def _stream_oracle_root(tmp_path) -> str:
+    """Uninterrupted run of the streamed workload: the height-2 root every
+    crashed-then-resumed run must converge to."""
+    kv = open_kv(str(tmp_path / "oracle.lsm"), "lsm")
+    try:
+        return run_stream_workload(kv)["root"]
+    finally:
+        kv.close()
+
+
+@pytest.mark.parametrize("hit", [1, 2])
+def test_streamed_commit_midstream_crash_injected(tmp_path, hit):
+    """In-process: die between streamed subtrie WAL batches and the root
+    record. The store must reopen at the OLD tip with only the repairable
+    orphan-block tear (streamed nodes are durable orphans, never a root
+    without its nodes), and the re-run commits the identical root."""
+    from lachain_tpu.storage.state import StateManager
+
+    db = str(tmp_path / "stream.lsm")
+    kv = open_kv(db, "lsm")
+    try:
+        base = run_stream_workload(kv, blocks=1)
+        assert base["height"] == 1 and base["streamed"] >= 2
+        # arm only around block 2: hits count from ITS commit's stream
+        with crashpoints.armed(
+            CrashPlan(
+                points=(CrashPoint("trie.merkle.subtree_streamed", hit),)
+            )
+        ) as session:
+            with pytest.raises(InjectedCrash):
+                run_stream_workload(kv, blocks=2)
+        assert session.fired == [("trie.merkle.subtree_streamed", hit)]
+    finally:
+        kv.close()
+
+    kv2 = open_kv(db, "lsm")
+    try:
+        report = fsck(kv2, repair=True)
+        assert not report.fatal, report.to_dict()
+        # block 2's own rows went durable before its state commit began
+        assert {i.code for i in report.issues} <= {"orphan-block"}, (
+            report.to_dict()
+        )
+        recheck = fsck(kv2, repair=False)
+        assert recheck.clean, recheck.to_dict()
+        assert StateManager(kv2).committed_height() == 1
+        stats = run_stream_workload(kv2)
+        assert stats["height"] == 2
+        assert stats["root"] == _stream_oracle_root(tmp_path)
+    finally:
+        kv2.close()
+
+
+def test_streamed_commit_midstream_sigkill(tmp_path):
+    """Real-death mode: SIGKILL between a streamed subtrie batch and the
+    root record (hit 5 = block 2's first streamed batch); replaying the
+    workload must converge to the identical root as an uninterrupted
+    run."""
+    import subprocess as sp
+
+    from lachain_tpu.storage.state import StateManager
+
+    db = str(tmp_path / "kill.lsm")
+    env = dict(os.environ)
+    env[crashpoints.ENV_VAR] = CrashPlan(
+        points=(CrashPoint("trie.merkle.subtree_streamed", 5, "sigkill"),)
+    ).encode_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    cmd = [
+        sys.executable, "-m", "lachain_tpu.storage.crash_workload",
+        db, "lsm", "stream",
+    ]
+    child = sp.run(cmd, env=env, capture_output=True, timeout=300)
+    assert child.returncode == -signal.SIGKILL, child.stderr.decode()
+
+    kv = open_kv(db, "lsm")
+    try:
+        report = fsck(kv, repair=True)
+        assert not report.fatal, report.to_dict()
+        assert {i.code for i in report.issues} <= {"orphan-block"}, (
+            report.to_dict()
+        )
+        assert StateManager(kv).committed_height() == 1
+    finally:
+        kv.close()
+
+    # resume: the workload completes and matches the uninterrupted oracle
+    env.pop(crashpoints.ENV_VAR)
+    out = sp.run(cmd, env=env, capture_output=True, timeout=300)
+    assert out.returncode == 0, out.stderr.decode()
+    import json as _json
+
+    stats = _json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert stats["height"] == 2
+    assert stats["root"] == _stream_oracle_root(tmp_path)
 
 
 # -- unrepairable states: fsck must refuse, never silently run --------------

@@ -68,5 +68,49 @@ def test_native_keccak_matches_python():
         data = rng.randbytes(size)
         assert keccak256(data) == _keccak256_py(data)
 
+def test_native_keccak_batch_matches_python():
+    """lt_keccak256_batch cross-check against the pure-Python sponge on
+    randomized lengths, the sponge-rate boundary (135/136/137) and the
+    empty input — single-threaded AND threaded must agree item-for-item."""
+    import random
+
+    from lachain_tpu.crypto.hashes import (
+        _batch_fn,
+        _keccak256_py,
+        keccak256_batch,
+    )
+
+    if _batch_fn() is None:
+        pytest.skip("native batch keccak unavailable")
+    rng = random.Random(7)
+    items = [b"", rng.randbytes(135), rng.randbytes(136), rng.randbytes(137)]
+    items += [rng.randbytes(rng.randrange(0, 600)) for _ in range(300)]
+    rng.shuffle(items)
+    expect = [_keccak256_py(d) for d in items]
+    assert keccak256_batch(items, 1) == expect
+    assert keccak256_batch(items, 4) == expect
+    assert keccak256_batch([], 4) == []
+    # a single item still round-trips through the batch entry point
+    assert keccak256_batch([b"abc"], 1) == [_keccak256_py(b"abc")]
+
+
+def test_keccak_batch_python_fallback():
+    """With the native path disabled the batch API must fall back to the
+    per-item implementation (stale .so / LACHAIN_TPU_HASHES=python)."""
+    from lachain_tpu.crypto import hashes
+
+    saved = hashes._batch_cache[:]
+    try:
+        hashes._batch_cache[0] = True
+        hashes._batch_cache[1] = None
+        data = [b"", b"abc", b"x" * 137]
+        assert hashes.keccak256_batch(data, 4) == [
+            hashes.keccak256(d) for d in data
+        ]
+    finally:
+        hashes._batch_cache[0] = saved[0]
+        hashes._batch_cache[1] = saved[1]
+
+
 # slice marker: crypto/accelerator kernels ("make test-kernel")
 pytestmark = pytest.mark.kernel
