@@ -12,7 +12,6 @@ pending batch) rather than 2 pairings per share.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 from ..crypto import threshold_sig as ts
 from . import messages as M

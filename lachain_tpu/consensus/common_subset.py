@@ -10,7 +10,7 @@ Behavioral parity with
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from . import messages as M
 from .protocol import Broadcaster, Protocol

@@ -32,7 +32,7 @@ Key layout: ``CONSENSUS_STATE | era u64 | seq u64`` ->
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 from ..storage.kv import EntryPrefix, KVStore, prefixed
 from ..utils import metrics

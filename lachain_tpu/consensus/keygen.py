@@ -35,8 +35,8 @@ per-era share verification (SURVEY.md §2a "centerpiece").
 from __future__ import annotations
 
 import secrets
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
 
 from ..crypto import bls12381 as bls
 from ..crypto import ecdsa

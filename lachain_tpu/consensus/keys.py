@@ -11,7 +11,7 @@ backend (python / native C++ / TPU-batched) never touches protocol code.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 from ..crypto import tpke
 from ..crypto import threshold_sig as ts

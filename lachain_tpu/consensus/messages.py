@@ -15,7 +15,7 @@ Envelope model (reference: Messages/MessageEnvelope.cs:5-35):
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, FrozenSet, Optional, Tuple
 
 # ---------------------------------------------------------------------------

@@ -26,7 +26,6 @@ import ctypes
 import os
 import struct
 import subprocess
-import time
 import weakref
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Set
@@ -113,17 +112,24 @@ _lib_cache: List[Any] = [None]
 def load_rt():
     if _lib_cache[0] is not None:
         return _lib_cache[0]
-    sources = [
-        os.path.join(_NATIVE_DIR, "consensus_rt.cpp"),
-        os.path.join(_NATIVE_DIR, "Makefile"),
-    ]
-    if not os.path.exists(_LIB_PATH) or any(
-        os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
-    ):
-        subprocess.run(
-            ["make", "-s", "-C", _NATIVE_DIR], check=True, capture_output=True
-        )
-    lib = ctypes.CDLL(_LIB_PATH)
+    # LACHAIN_CONSENSUS_LIB loads an alternate engine build verbatim (the
+    # ASan/TSan gates in tests/native/ point it at instrumented builds) —
+    # no mtime-rebuild, same contract as LACHAIN_LSM_LIB in storage/lsm.py
+    override = os.environ.get("LACHAIN_CONSENSUS_LIB")
+    lib_path = override or _LIB_PATH
+    if not override:
+        sources = [
+            os.path.join(_NATIVE_DIR, "consensus_rt.cpp"),
+            os.path.join(_NATIVE_DIR, "Makefile"),
+        ]
+        if not os.path.exists(_LIB_PATH) or any(
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(s) for s in sources
+        ):
+            subprocess.run(
+                ["make", "-s", "-C", _NATIVE_DIR], check=True,
+                capture_output=True,
+            )
+    lib = ctypes.CDLL(lib_path)
     lib.lt_crt_version.restype = ctypes.c_int
     assert lib.lt_crt_version() == 4
     lib.rt_new.restype = ctypes.c_void_p

@@ -17,7 +17,7 @@ traffic to it.
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Optional
 
 from . import messages as M
 

@@ -19,9 +19,8 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..crypto.hashes import keccak256
 from ..storage.kv import EntryPrefix, KVStore, prefixed
-from ..storage.state import Snapshot, StateManager, StateRoots
+from ..storage.state import StateManager, StateRoots
 from ..utils import metrics
 from ..utils import bloom
 from ..utils import tracing
@@ -37,7 +36,6 @@ from .types import (
     BlockHeader,
     MultiSig,
     SignedTransaction,
-    TransactionReceipt,
     ZERO_HASH,
     tx_merkle_root,
     warm_sender_caches,

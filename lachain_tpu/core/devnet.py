@@ -26,7 +26,7 @@ from ..utils.serialization import write_u64
 from . import system_contracts
 from .block_manager import BlockManager
 from .block_producer import BlockProducer
-from .execution import TransactionExecuter, get_balance, get_nonce, set_balance
+from .execution import get_balance, get_nonce, set_balance
 from .tx_pool import TransactionPool
 from .types import (
     ZERO_HASH,
@@ -34,8 +34,6 @@ from .types import (
     BlockHeader,
     MultiSig,
     SignedTransaction,
-    Transaction,
-    sign_transaction,
 )
 
 DEFAULT_CHAIN_ID = 225  # our own chain id

@@ -14,13 +14,10 @@ trick free.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
 
-from ..crypto.hashes import keccak256
 from ..storage.state import Snapshot
-from ..utils.serialization import Reader, write_u32, write_u64, write_u256
+from ..utils.serialization import write_u32, write_u64, write_u256
 from .types import (
-    ADDRESS_BYTES,
     SignedTransaction,
     TransactionReceipt,
     ZERO_ADDRESS,

@@ -19,7 +19,7 @@ from ..network import wire
 from ..network.manager import NetworkManager
 from .block_manager import BlockManager
 from .tx_pool import TransactionPool
-from .types import Block, MultiSig, SignedTransaction
+from .types import Block, SignedTransaction
 
 logger = logging.getLogger(__name__)
 

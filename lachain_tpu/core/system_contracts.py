@@ -22,9 +22,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..crypto import vrf
 from ..crypto.hashes import keccak256
 from ..storage.state import Snapshot
-from ..utils.serialization import Reader, write_bytes, write_u32, write_u64, write_u256
+from ..utils.serialization import Reader, write_u32, write_u64, write_u256
 from . import execution
-from .types import ADDRESS_BYTES, Transaction, ZERO_ADDRESS
+from .types import ADDRESS_BYTES, Transaction
 
 DEPLOY_ADDRESS = b"\x00" * 19 + b"\x00"
 NATIVE_TOKEN_ADDRESS = b"\x00" * 19 + b"\x01"

@@ -8,8 +8,8 @@ canonical encoding (chain-id mixed into the signing hash, EIP-155-style).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
 
 from ..crypto import ecdsa
 from ..crypto.hashes import keccak256, merkle_root

@@ -9,7 +9,7 @@ genesis key set applies until the first rotation lands.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from ..consensus.keys import PublicConsensusKeys
 from ..storage.state import StateManager

@@ -31,7 +31,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import math
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 # ---------------------------------------------------------------------------
 # Parameters

@@ -30,7 +30,7 @@ import logging
 import os
 import pickle
 import threading
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 from ..utils import metrics
 

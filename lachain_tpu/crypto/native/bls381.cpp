@@ -2108,15 +2108,18 @@ static void xof(uint8_t *out, size_t outlen, const uint8_t *dom, size_t domlen,
 // ===========================================================================
 
 // big-endian bytes -> Fp via mod p (generic width)
+static Fp make_mont_u64(u64 x) {
+  Fp z;
+  fp_set_u64(z, x);
+  return z;
+}
+
 static void fp_from_wide_be(Fp &z, const uint8_t *in, size_t len) {
   // Horner in base 2^8 over Montgomery field elements: digit-by-digit.
-  // mont(256) precomputed once.
-  static Fp mont256;
-  static bool init256 = false;
-  if (!init256) {
-    fp_set_u64(mont256, 256);
-    init256 = true;
-  }
+  // mont(256) precomputed once — as a magic static (guarded init): the
+  // hand-rolled `bool init256` latch here was a data race when two
+  // threads hash-to-curve concurrently (lt_g2_hash from the verify pool)
+  static const Fp mont256 = make_mont_u64(256);
   Fp acc;
   memset(acc.v, 0, 48);
   for (size_t i = 0; i < len; i++) {

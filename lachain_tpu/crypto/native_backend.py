@@ -41,8 +41,16 @@ def _build_if_needed() -> None:
 
 
 def load_lib():
-    _build_if_needed()
-    lib = ctypes.CDLL(_LIB_PATH)
+    # LACHAIN_BLS_LIB loads an alternate backend build verbatim (the
+    # ASan/TSan gates in tests/native/ point it at instrumented builds) —
+    # no mtime-rebuild, same contract as LACHAIN_LSM_LIB in storage/lsm.py
+    override = os.environ.get("LACHAIN_BLS_LIB")
+    if override:
+        lib_path = override
+    else:
+        _build_if_needed()
+        lib_path = _LIB_PATH
+    lib = ctypes.CDLL(lib_path)
     lib.lt_version.restype = ctypes.c_int
     assert lib.lt_version() == 1
     return lib

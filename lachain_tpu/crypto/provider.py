@@ -21,8 +21,7 @@ exactly the shape TPUs are good at.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 from . import bls12381 as bls
 

@@ -22,8 +22,7 @@ evaluated in exact integer arithmetic (no floats -> consensus-safe).
 from __future__ import annotations
 
 import hashlib
-from fractions import Fraction
-from typing import Optional, Tuple
+from typing import Tuple
 
 from . import ecdsa as ec
 from .hashes import sha256

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from ..crypto import bls12381 as bls

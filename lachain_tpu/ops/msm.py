@@ -34,7 +34,6 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

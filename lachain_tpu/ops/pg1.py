@@ -48,7 +48,7 @@ non-Pallas fallback (and the multi-chip shard_map path).
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 

@@ -38,7 +38,7 @@ at the same chain depths as pg1's G1 formulas.
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 
@@ -50,15 +50,10 @@ from jax.experimental.pallas import tpu as pltpu
 from . import msm, pg1
 from ..crypto import bls12381 as bls
 from .pg1 import (
-    BASE,
-    CONVLEN,
     INTERPRET,
-    MASK,
     NLIMBS,
-    P_INT,
     POINT_ROWS,
     TABLE,
-    W64,
     WINDOW,
     _add,
     _const_args,

@@ -18,14 +18,14 @@ exposed through __graft_entry__ and driven by bench.py.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from . import curve, fp, msm
+from . import curve, msm
 from ..crypto import bls12381 as bls
 
 

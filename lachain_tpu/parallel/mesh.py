@@ -17,7 +17,7 @@ on the host network (lachain_tpu/network).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

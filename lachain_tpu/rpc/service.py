@@ -14,7 +14,6 @@ from __future__ import annotations
 import binascii
 from typing import Any, Dict, List, Optional
 
-from .. import __name__ as _pkg
 from ..core import execution
 from ..core.types import Block, SignedTransaction, TransactionReceipt
 from ..crypto import ecdsa

@@ -62,7 +62,7 @@ import os
 import signal
 import threading
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 ENV_VAR = "LACHAIN_CRASH_POINTS"

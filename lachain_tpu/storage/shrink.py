@@ -25,7 +25,7 @@ from typing import Optional
 from .crashpoints import crash_point
 from .kv import EntryPrefix, KVStore, prefixed
 from .state import StateManager, StateRoots
-from .trie import EMPTY_ROOT, InternalNode, LeafNode
+from .trie import EMPTY_ROOT, InternalNode
 
 logger = logging.getLogger(__name__)
 

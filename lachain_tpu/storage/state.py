@@ -15,11 +15,10 @@ functional idiom the TPU stack already uses.
 """
 from __future__ import annotations
 
-import json
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from ..utils.serialization import Reader, write_bytes, write_u64
+from ..utils.serialization import Reader, write_u64
 from .kv import EntryPrefix, KVStore, prefixed
 from .trie import EMPTY_ROOT, Trie
 

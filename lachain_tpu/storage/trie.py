@@ -23,7 +23,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..crypto.hashes import keccak256, keccak256_batch
-from ..utils.serialization import Reader, write_bytes, write_u16, write_u32
+from ..utils.serialization import Reader, write_bytes, write_u16
 from .kv import EntryPrefix, KVStore, prefixed
 
 EMPTY_ROOT = b"\x00" * 32

@@ -8,7 +8,7 @@ used across consensus messages and storage records.
 from __future__ import annotations
 
 import struct
-from typing import Iterator, List, Sequence, Tuple
+from typing import List, Sequence
 
 
 def write_u16(v: int) -> bytes:

@@ -10,7 +10,7 @@ in order).
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import Sequence, Union
 
 from ..crypto.hashes import keccak256
 

@@ -9,7 +9,9 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple, Union
 
-from .wasm import F32, F64, I32, I64, WASM_MAGIC, WASM_VERSION
+# F32/F64/I32/I64 are re-exported: tests and contract builders import
+# the valtype constants from here alongside ModuleBuilder
+from .wasm import F32, F64, I32, I64, WASM_MAGIC, WASM_VERSION  # noqa: F401
 
 Body = Union[bytes, Sequence[Union[int, bytes]]]
 

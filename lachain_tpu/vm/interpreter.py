@@ -28,8 +28,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .wasm import (
     BLOCK_EMPTY,
-    F32,
-    F64,
     FuncType,
     Function,
     I32,

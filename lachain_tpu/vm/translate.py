@@ -50,7 +50,6 @@ Design:
 """
 from __future__ import annotations
 
-import os
 import struct as _struct
 from typing import List, Optional
 

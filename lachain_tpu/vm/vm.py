@@ -23,7 +23,6 @@ from .interpreter import (
     GasMeter,
     Instance,
     OutOfGas,
-    WasmTrap,
 )
 from .wasm import WasmDecodeError, decode_module
 

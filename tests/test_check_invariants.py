@@ -1,0 +1,358 @@
+"""Tests for tools/check_invariants.py — the repo-invariant linter.
+
+One fixture tree per violation class (written under tmp_path as a
+miniature `lachain_tpu/` package), plus a clean-HEAD run proving the
+real repo has zero false positives. Each evil fixture must FAIL (exit 1
+with the expected rule id) and each paired good fixture must PASS —
+the linter is itself a gate, so both directions are load-bearing.
+"""
+import importlib.util
+import os
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "check_invariants", os.path.join(REPO_ROOT, "tools", "check_invariants.py")
+)
+ci = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ci)
+
+
+def make_repo(tmp_path, files):
+    """Write {relpath-under-lachain_tpu: source} and return the root."""
+    for rel, src in files.items():
+        p = tmp_path / "lachain_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def run_lint(tmp_path, files, capsys):
+    root = make_repo(tmp_path, files)
+    rc = ci.run(root)
+    cap = capsys.readouterr()
+    return rc, cap.out, cap.err
+
+
+# -- rule D: determinism -----------------------------------------------------
+
+
+def test_determinism_flags_wall_clock_entropy_hash_and_sets(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evil_time.py": """
+            import time
+            import random
+            import os
+
+            def decide(payloads):
+                t = time.time()
+                jitter = random.random()
+                salt = os.urandom(8)
+                h = hash(payloads[0])
+                for p in {"a", "b"}:
+                    t += len(p)
+                rng = random.Random()
+                return t, jitter, salt, h, rng
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "wall-clock call time.time()" in out
+    assert "process-global RNG call random.random()" in out
+    assert "entropy tap os.urandom()" in out
+    assert "builtin hash()" in out
+    assert "iteration over a set display" in out
+    # dotted, argless random.Random() reports via the process-global rule
+    assert "process-global RNG call random.Random()" in out
+    assert out.count("[determinism]") == 6
+
+
+def test_determinism_allows_monotonic_and_seeded_rng(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/good_time.py": """
+            import time
+            import random
+
+            def measure(seed):
+                t0 = time.monotonic()
+                t1 = time.perf_counter()
+                rng = random.Random(seed)
+                for p in sorted({"a", "b"}):
+                    t0 += len(p)
+                return t1 - t0, rng.randrange(4)
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_determinism_sees_through_import_aliases(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/aliased.py": """
+            import time as _clk
+            from datetime import datetime as _dt
+
+            def stamp():
+                return _clk.time(), _dt.now()
+        """,
+    }, capsys)
+    assert rc == 1
+    assert out.count("[determinism]") == 2
+
+
+def test_determinism_scoped_to_consensus_modules(tmp_path, capsys):
+    # the same hazards OUTSIDE the deterministic scope are legal: metrics,
+    # benchmarks and network jitter legitimately read the wall clock
+    rc, out, _ = run_lint(tmp_path, {
+        "rpc/service_like.py": """
+            import time
+
+            def uptime():
+                return time.time()
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_lint_allow_escape_hatch_is_counted(tmp_path, capsys):
+    rc, out, err = run_lint(tmp_path, {
+        "consensus/escaped.py": """
+            import time
+
+            def boot_banner():
+                return time.time()  # lint-allow: determinism log banner only
+        """,
+    }, capsys)
+    assert rc == 0, out
+    assert "1 lint-allow line(s)" in err
+
+
+# -- rule P: persist-before-transmit -----------------------------------------
+
+
+def test_transmit_without_journal_is_flagged(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evil_send.py": """
+            class Router:
+                def broadcast(self, msg):
+                    self._send(msg)
+                    self._durable_send(msg)
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[persist-before-transmit]" in out
+    assert "self._send(...) in broadcast()" in out
+
+
+def test_journal_before_transmit_is_clean(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/good_send.py": """
+            class Router:
+                def broadcast(self, msg):
+                    self._durable_send(msg)
+                    self._send(msg)
+
+                def relay(self, msg):
+                    self.journal.record(msg)
+                    self._engine_transport(msg)
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_replay_functions_are_whitelisted(tmp_path, capsys):
+    # replay_outbox re-sends bytes that are ALREADY journaled — the
+    # whitelist in the linter documents exactly this
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/replayer.py": """
+            class Router:
+                def replay_outbox(self):
+                    for msg in self._outbox:
+                        self._engine_transport(msg)
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_nested_def_sends_not_misattributed(tmp_path, capsys):
+    # a transport call inside a nested closure belongs to the closure,
+    # not the enclosing function: the enclosing fn must not be flagged
+    # just because a helper it DEFINES (but may never call) transmits
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/nested.py": """
+            class Router:
+                def build(self):
+                    def flush(msg):
+                        self._durable_send(msg)
+                        self._send(msg)
+                    return flush
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+# -- rule L: lock order ------------------------------------------------------
+
+
+def test_lock_order_cycle_direct(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evil_locks.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def fwd():
+                with _a:
+                    with _b:
+                        pass
+
+            def rev():
+                with _b:
+                    with _a:
+                        pass
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[lock-order]" in out
+    assert "cycle" in out
+
+
+def test_lock_order_cycle_through_call_graph(tmp_path, capsys):
+    # the reverse edge only exists interprocedurally: rev() holds _b and
+    # CALLS helper(), which acquires _a — the fixpoint must find it
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evil_calls.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def fwd():
+                with _a:
+                    with _b:
+                        pass
+
+            def helper():
+                with _a:
+                    pass
+
+            def rev():
+                with _b:
+                    helper()
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[lock-order]" in out
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/good_locks.py": """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def one():
+                with _a:
+                    with _b:
+                        pass
+
+            def two():
+                with _a:
+                    with _b:
+                        pass
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_self_deadlock_on_plain_lock_only(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/self_lock.py": """
+            import threading
+
+            _plain = threading.Lock()
+
+            def oops():
+                with _plain:
+                    with _plain:
+                        pass
+        """,
+        "consensus/self_rlock.py": """
+            import threading
+
+            _re = threading.RLock()
+
+            def fine():
+                with _re:
+                    with _re:
+                        pass
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "self-deadlock" in out
+    # the RLock re-entry must NOT appear
+    assert "_re" not in out
+
+
+def test_cross_module_lock_edges_via_imports(tmp_path, capsys):
+    # metrics-singleton pattern: consensus code holds its own lock and
+    # calls into an imported lachain_tpu module that takes another lock;
+    # that module reverses the order -> cycle spans two files
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/caller.py": """
+            import threading
+            from lachain_tpu.observability import metrics_like
+
+            _era = threading.Lock()
+
+            def report():
+                with _era:
+                    metrics_like.observe(1)
+        """,
+        "observability/metrics_like.py": """
+            import threading
+            from lachain_tpu.consensus import caller
+
+            _reg = threading.Lock()
+
+            def observe(v):
+                with _reg:
+                    pass
+
+            def poke():
+                with _reg:
+                    caller.report()
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[lock-order]" in out
+
+
+# -- driver behaviour --------------------------------------------------------
+
+
+def test_parse_error_is_usage_error(tmp_path, capsys):
+    rc, _, err = run_lint(tmp_path, {
+        "consensus/broken.py": "def broken(:\n",
+    }, capsys)
+    assert rc == 2
+    assert "parse error" in err
+
+
+def test_missing_package_root(tmp_path, capsys):
+    rc = ci.run(str(tmp_path / "nowhere"))
+    capsys.readouterr()
+    assert rc == 2
+
+
+@pytest.mark.slow
+def test_clean_head_has_zero_violations(capsys):
+    # the gate that `make lint` enforces: the real repo is clean
+    rc = ci.run(REPO_ROOT)
+    cap = capsys.readouterr()
+    assert rc == 0, cap.out
+    assert "0 violation(s)" in cap.err

@@ -5,7 +5,6 @@ Mirrors the reference's fault-injection pattern
 CreateDecryptedMessage to emit corrupted shares; SilentProtocol.cs for
 do-nothing players).
 """
-import random
 
 import pytest
 

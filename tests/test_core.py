@@ -15,7 +15,6 @@ from lachain_tpu.core.block_manager import BlockManager
 from lachain_tpu.core.devnet import DEFAULT_CHAIN_ID, Devnet
 from lachain_tpu.core.tx_pool import TransactionPool
 from lachain_tpu.core.types import (
-    Block,
     BlockHeader,
     MultiSig,
     SignedTransaction,

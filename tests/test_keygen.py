@@ -12,7 +12,6 @@ import pytest
 from lachain_tpu.consensus import keygen as kg
 from lachain_tpu.crypto import bls12381 as bls
 from lachain_tpu.crypto import ecdsa
-from lachain_tpu.crypto import threshold_sig as ts
 
 
 class SeededRng:

@@ -27,7 +27,6 @@ from lachain_tpu.crypto import tpke
 from lachain_tpu.parallel.mesh import (
     MeshEraPipeline,
     make_era_mesh,
-    pad_pow2,
     sharded_glv_era_step,
 )
 

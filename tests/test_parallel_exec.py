@@ -33,10 +33,10 @@ from lachain_tpu.crypto import ecdsa
 from lachain_tpu.storage.kv import MemoryKV
 from lachain_tpu.storage.state import StateManager
 from lachain_tpu.utils import metrics, tracing
-from lachain_tpu.utils.serialization import write_bytes, write_u256
+from lachain_tpu.utils.serialization import write_u256
 from lachain_tpu.vm.vm import deploy_code
 
-from test_vm import SEL_GET, SEL_INC, counter_contract
+from test_vm import SEL_INC, counter_contract
 
 pytestmark = pytest.mark.exec
 

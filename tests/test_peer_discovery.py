@@ -3,10 +3,8 @@ gossip-learned addresses; the reference reaches peers through bootstrap
 relays, HubConnector.cs:26-105 + config_mainnet.json:22-33)."""
 import asyncio
 
-import pytest
 
 from lachain_tpu.crypto import ecdsa
-from lachain_tpu.network.hub import PeerAddress
 from lachain_tpu.network.manager import NetworkManager
 
 

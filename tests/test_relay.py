@@ -6,7 +6,6 @@ delivered over its own outbound connection."""
 import asyncio
 import random
 
-import pytest
 
 from lachain_tpu.consensus.keys import trusted_key_gen
 from lachain_tpu.core.node import Node

@@ -14,7 +14,6 @@ from lachain_tpu.consensus.keys import trusted_key_gen
 from lachain_tpu.core import system_contracts as sc
 from lachain_tpu.core.node import Node
 from lachain_tpu.core.types import (
-    Block,
     BlockHeader,
     MultiSig,
     Transaction,

@@ -3,9 +3,7 @@
 Mirrors the reference's storage suites (test/Lachain.StorageTest/RocksDbTest,
 StorageIntergrationTest — trie/state snapshot/rollback/hash consistency).
 """
-import os
 import random
-import tempfile
 
 import pytest
 

@@ -9,7 +9,7 @@ import random
 
 import pytest
 
-from lachain_tpu.core import execution, system_contracts as sc
+from lachain_tpu.core import system_contracts as sc
 from lachain_tpu.core.block_manager import BlockManager
 from lachain_tpu.core.keygen_manager import KeyGenManager
 from lachain_tpu.core.types import Transaction, sign_transaction

@@ -1,0 +1,710 @@
+#!/usr/bin/env python
+"""Repo-invariant linter: static checks for the guarantees the tests assume.
+
+Three rule families over `lachain_tpu/` (AST-based, zero dependencies):
+
+D. **Determinism** — the consensus modules (`consensus/`,
+   `core/parallel_exec.py`, `storage/trie.py`) must replay bit-identically:
+   two runs from the same journal/seed may never diverge. Wall-clock reads
+   (`time.time`, `datetime.now`), the process-global RNG (`random.*` on the
+   module, unseeded `random.Random()`), entropy taps (`os.urandom`,
+   `secrets.*`, `uuid.uuid4`), the builtin `hash()` (salted per process via
+   PYTHONHASHSEED) and iteration over set displays/constructors (order is
+   hash-salted for str/bytes elements) are all flagged. `time.monotonic` /
+   `time.perf_counter` stay legal: they feed metrics and stall reports,
+   never consensus values — reviewers guard that boundary, the linter
+   guards the sharper one. Seeded `random.Random(seed)` is legal (the
+   chaos matrices inject their seeds).
+
+L. **Lock order** — every `threading.Lock()`/`RLock()` in the repo is
+   discovered (module globals, `self.<attr>` fields — the tx-pool's 16
+   shard domains collapse onto their class attribute — and dict-registry
+   locks), then an acquires-while-holding graph is built from lexically
+   nested `with` blocks plus a call-graph fixpoint (self-calls, same-module
+   calls, and cross-module calls through imported `lachain_tpu` modules,
+   e.g. the tracing/metrics singletons). Any cycle is a potential deadlock
+   and fails the build. Self-edges are reported only for non-reentrant
+   Lock identities (an RLock re-entered by the same thread is legal; the
+   linter cannot distinguish sibling instances, so RLock classes like the
+   pool shards rely on their documented no-two-shards rule).
+
+P. **Persist-before-transmit** — in `consensus/`, a raw transport send
+   (`self._send(...)`, `self._engine_transport(...)`) must be dominated by
+   a journal write (`_durable_send` / `_native_send` /
+   `<journal>.record`) in the same function, approximated as "a journal
+   call appears on an earlier line of the same function body". Functions
+   that REPLAY already-journaled bytes are whitelisted below, with the
+   reason recorded next to the name.
+
+Escape hatch: a line ending in `# lint-allow: <rule-id> <reason>` silences
+that line for that rule. Allowed lines are counted and printed so silent
+growth of the whitelist shows up in review diffs.
+
+Exit status: 0 clean, 1 violations, 2 usage/parse errors.
+Run as `python tools/check_invariants.py [repo-root]` (part of `make lint`).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+# -- configuration -----------------------------------------------------------
+
+PACKAGE = "lachain_tpu"
+
+# rule D applies to these path prefixes/files (relative to the package root)
+DETERMINISTIC_PREFIXES = ("consensus/",)
+DETERMINISTIC_FILES = ("core/parallel_exec.py", "storage/trie.py")
+
+# wall-clock attribute calls banned under rule D: module-alias . attr
+WALL_CLOCK = {
+    ("time", "time"),
+    ("time", "time_ns"),
+    ("time", "ctime"),
+    ("time", "localtime"),
+    ("time", "gmtime"),
+    ("time", "strftime"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("datetime", "today"),
+    ("date", "today"),
+}
+
+# entropy taps banned under rule D (module-alias . attr)
+ENTROPY = {
+    ("os", "urandom"),
+    ("uuid", "uuid4"),
+    ("uuid", "uuid1"),
+}
+ENTROPY_MODULES = ("secrets",)
+
+# rule P: raw transport callees and the journal calls that must dominate them
+TRANSPORT_CALLEES = ("_send", "_engine_transport")
+JOURNAL_CALLEES = ("_durable_send", "_native_send", "record")
+# functions allowed to transport without journaling, and why. Keyed by
+# function name within lachain_tpu/consensus/.
+TRANSMIT_WHITELIST = {
+    # replays payloads that went through _durable_send when first sent; a
+    # replay of a replay must NOT be re-recorded (unbounded outbox growth)
+    "replay_outbox": "re-sends already-journaled outbox entries",
+    # recovery path: re-arms latches from journal records that are durable
+    # by definition; it never touches the transport
+    "rearm_sent": "seeds latches from already-durable journal records",
+}
+
+ALLOW_MARK = "# lint-allow:"
+
+
+# -- shared helpers ----------------------------------------------------------
+
+
+class Violation:
+    __slots__ = ("path", "line", "rule", "msg")
+
+    def __init__(self, path: str, line: int, rule: str, msg: str):
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """x / x.y / x.y.z -> dotted string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _line_allowed(src_lines: List[str], lineno: int, rule: str) -> bool:
+    if 1 <= lineno <= len(src_lines):
+        line = src_lines[lineno - 1]
+        if ALLOW_MARK in line:
+            tail = line.split(ALLOW_MARK, 1)[1].strip()
+            return tail.startswith(rule)
+    return False
+
+
+def _is_lock_ctor(node: ast.AST) -> Optional[str]:
+    """threading.Lock() / threading.RLock() / Lock() -> "Lock"/"RLock"."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _dotted(node.func)
+    if name in ("threading.Lock", "Lock"):
+        return "Lock"
+    if name in ("threading.RLock", "RLock"):
+        return "RLock"
+    return None
+
+
+# -- rule D: determinism -----------------------------------------------------
+
+
+def check_determinism(
+    relpath: str, tree: ast.Module, src_lines: List[str]
+) -> List[Violation]:
+    out: List[Violation] = []
+
+    def flag(node: ast.AST, msg: str) -> None:
+        if not _line_allowed(src_lines, node.lineno, "determinism"):
+            out.append(Violation(relpath, node.lineno, "determinism", msg))
+
+    # alias map so `import time as _time; _time.time()` is still caught
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def base_module(name: str) -> str:
+        return aliases.get(name, name).split(".")[0]
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted and "." in dotted:
+                head, attr = dotted.split(".")[0], dotted.split(".")[-1]
+                mod = base_module(head)
+                if (mod, attr) in WALL_CLOCK:
+                    flag(node, f"wall-clock call {dotted}() in a "
+                               "deterministic consensus module")
+                elif (mod, attr) in ENTROPY or mod in ENTROPY_MODULES:
+                    flag(node, f"entropy tap {dotted}() in a deterministic "
+                               "consensus module")
+                elif mod == "random":
+                    # random.Random(seed) builds an injectable seeded RNG;
+                    # everything else on the module is the process-global
+                    # unseeded generator
+                    if attr == "Random" and (node.args or node.keywords):
+                        pass
+                    else:
+                        flag(node, f"process-global RNG call {dotted}() — "
+                                   "inject a seeded random.Random instead")
+            elif isinstance(node.func, ast.Name):
+                fn = node.func.id
+                if fn == "hash":
+                    flag(node, "builtin hash() is salted per process "
+                               "(PYTHONHASHSEED) — use a content hash")
+                elif fn == "Random" and base_module(fn).startswith("random"):
+                    if not (node.args or node.keywords):
+                        flag(node, "unseeded random.Random() — pass a seed")
+        # iteration over a set display / set() constructor: element order is
+        # hash-salted for str/bytes
+        iter_expr = None
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iter_expr = node.iter
+        elif isinstance(node, ast.comprehension):
+            iter_expr = node.iter
+        if iter_expr is not None:
+            tgt = None
+            if isinstance(iter_expr, ast.Set):
+                tgt = "a set display"
+            elif isinstance(iter_expr, ast.Call) and isinstance(
+                iter_expr.func, ast.Name
+            ) and iter_expr.func.id in ("set", "frozenset"):
+                tgt = f"{iter_expr.func.id}(...)"
+            if tgt:
+                flag(iter_expr, f"iteration over {tgt}: order is "
+                                "hash-salted — sort first")
+    return out
+
+
+# -- rule L: lock-order ------------------------------------------------------
+
+
+class _FnInfo:
+    __slots__ = ("qualname", "relpath", "acquires", "held_calls",
+                 "held_acquires", "calls")
+
+    def __init__(self, qualname: str, relpath: str):
+        self.qualname = qualname
+        self.relpath = relpath
+        # lock ids acquired anywhere in the body
+        self.acquires: Set[str] = set()
+        # (held lock id, callee key, lineno)
+        self.held_calls: List[Tuple[str, str, int]] = []
+        # (held lock id, acquired lock id, lineno) — direct lexical nesting
+        self.held_acquires: List[Tuple[str, str, int]] = []
+        # callee keys invoked anywhere (for the fixpoint)
+        self.calls: Set[str] = set()
+
+
+class LockOrderChecker:
+    """Build the acquires-while-holding graph and fail on cycles."""
+
+    def __init__(self) -> None:
+        # lock id -> kind ("Lock"/"RLock")
+        self.locks: Dict[str, str] = {}
+        # attr name -> {lock ids} (for resolving self.X in defining class)
+        self.class_attr: Dict[Tuple[str, str, str], str] = {}
+        # (relpath, global name) -> lock id
+        self.module_global: Dict[Tuple[str, str], str] = {}
+        # lock-returning helper: (relpath, func name) -> lock id
+        self.lock_returning: Dict[Tuple[str, str], str] = {}
+        self.fns: Dict[str, _FnInfo] = {}
+        # callee key -> candidate fn qualnames
+        self.candidates: Dict[str, List[str]] = defaultdict(list)
+        # (relpath, alias) -> imported lachain_tpu module relpath
+        self.imports: Dict[Tuple[str, str], str] = {}
+        # edges: (held, acquired) -> example (relpath, lineno)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    # -- pass 1: discovery ---------------------------------------------------
+    def discover(self, relpath: str, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._record_import(relpath, node)
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _is_lock_ctor(node.value)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            lid = f"{relpath}::{tgt.id}"
+                            self.locks[lid] = kind
+                            self.module_global[(relpath, tgt.id)] = lid
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        kind = _is_lock_ctor(sub.value)
+                        if not kind:
+                            continue
+                        for tgt in sub.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                lid = f"{relpath}::{node.name}.{tgt.attr}"
+                                self.locks[lid] = kind
+                                self.class_attr[
+                                    (relpath, node.name, tgt.attr)
+                                ] = lid
+            elif isinstance(node, ast.FunctionDef):
+                # dict-registry factory: a function that creates Lock()s and
+                # returns them (kernel_cache._lock_for) gets one synthetic
+                # identity for the whole registry
+                makes_lock = any(
+                    _is_lock_ctor(s.value)
+                    for s in ast.walk(node)
+                    if isinstance(s, ast.Assign)
+                )
+                returns = any(
+                    isinstance(s, ast.Return) and s.value is not None
+                    for s in ast.walk(node)
+                )
+                if makes_lock and returns:
+                    lid = f"{relpath}::{node.name}()"
+                    self.locks[lid] = "Lock"
+                    self.lock_returning[(relpath, node.name)] = lid
+
+    def _record_import(self, relpath: str, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(PACKAGE + "."):
+                    mod = a.name.replace(".", "/") + ".py"
+                    self.imports[(relpath, a.asname or a.name.split(".")[-1])
+                                 ] = mod
+        elif isinstance(node, ast.ImportFrom) and node.level >= 0:
+            # relative "from ..utils import metrics" — resolve against the
+            # importing file's package position
+            base: List[str]
+            if node.level:
+                parts = relpath.split("/")[:-1]
+                base = parts[: len(parts) - (node.level - 1)]
+            elif node.module and node.module.startswith(PACKAGE):
+                base = node.module.split(".")
+            else:
+                return
+            prefix = "/".join(p for p in base if p)
+            if node.level and node.module:
+                prefix = "/".join(
+                    [prefix, node.module.replace(".", "/")]
+                ).strip("/")
+            for a in node.names:
+                cand = (prefix + "/" + a.name + ".py").lstrip("/")
+                self.imports[(relpath, a.asname or a.name)] = cand
+
+    # -- pass 2a: register every function qualname BEFORE any body scan, so
+    # cross-file call resolution is independent of file visit order
+    def register_functions(self, relpath: str, tree: ast.Module) -> None:
+        def walk_scope(body, qual_prefix: str) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{qual_prefix}{node.name}"
+                    self.fns[qual] = _FnInfo(qual, relpath)
+                    self.candidates[node.name].append(qual)
+                    walk_scope(node.body, qual + ".")
+                elif isinstance(node, ast.ClassDef):
+                    walk_scope(node.body, f"{relpath}::{node.name}.")
+
+        walk_scope(tree.body, f"{relpath}::")
+
+    # -- pass 2b: per-function body analysis ----------------------------------
+    def analyze(self, relpath: str, tree: ast.Module,
+                src_lines: List[str]) -> None:
+        self._src_lines = src_lines
+
+        def walk_scope(body, qual_prefix: str, cls: Optional[str]) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{qual_prefix}{node.name}"
+                    self._scan_fn(relpath, cls, node, self.fns[qual],
+                                  held=[])
+                    walk_scope(node.body, qual + ".", cls)
+                elif isinstance(node, ast.ClassDef):
+                    walk_scope(
+                        node.body, f"{relpath}::{node.name}.", node.name
+                    )
+
+        walk_scope(tree.body, f"{relpath}::", None)
+
+    def _resolve_lock(self, relpath: str, cls: Optional[str],
+                      expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            lid = self.module_global.get((relpath, expr.id))
+            if lid:
+                return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if cls is not None:
+                    lid = self.class_attr.get((relpath, cls, attr))
+                    if lid:
+                        return lid
+            # non-self attribute (shard.lock): unique attr-name match across
+            # every discovered class lock — ambiguity means no resolution
+            matches = {
+                lid
+                for (rp, c, a), lid in self.class_attr.items()
+                if a == attr
+            }
+            if len(matches) == 1:
+                return next(iter(matches))
+            return None
+        if isinstance(expr, ast.Call):
+            name = None
+            if isinstance(expr.func, ast.Name):
+                name = expr.func.id
+            if name:
+                lid = self.lock_returning.get((relpath, name))
+                if lid:
+                    return lid
+        return None
+
+    def _callee_keys(self, relpath: str, cls: Optional[str],
+                     call: ast.Call) -> List[str]:
+        """Resolve a call to candidate function qualnames (conservative)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            q = f"{relpath}::{f.id}"
+            return [q] if q in self.fns else []
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                base = f.value.id
+                if base == "self" and cls is not None:
+                    q = f"{relpath}::{cls}.{f.attr}"
+                    if q in self.fns:
+                        return [q]
+                    q2 = f"{relpath}::{f.attr}"
+                    return [q2] if q2 in self.fns else []
+                mod = self.imports.get((relpath, base))
+                if mod is not None:
+                    q = f"{mod}::{f.attr}"
+                    return [q] if q in self.fns else []
+        return []
+
+    def _scan_fn(self, relpath: str, cls: Optional[str], fn,
+                 info: _FnInfo, held: List[str]) -> None:
+        def visit(stmts, held: List[str]) -> None:
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested defs analyzed in their own scope
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired: List[str] = []
+                    for item in node.items:
+                        lid = self._resolve_lock(
+                            relpath, cls, item.context_expr
+                        )
+                        if lid is not None:
+                            if not _line_allowed(
+                                self._src_lines, node.lineno, "lock-order"
+                            ):
+                                info.acquires.add(lid)
+                                for h in held:
+                                    info.held_acquires.append(
+                                        (h, lid, node.lineno)
+                                    )
+                            acquired.append(lid)
+                    visit(node.body, held + acquired)
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        for key in self._callee_keys(relpath, cls, sub):
+                            info.calls.add(key)
+                            for h in held:
+                                info.held_calls.append(
+                                    (h, key, sub.lineno)
+                                )
+                # recurse into compound statements' bodies for With nesting
+                for attr in ("body", "orelse", "finalbody"):
+                    sub_body = getattr(node, attr, None)
+                    if sub_body and isinstance(sub_body, list):
+                        # avoid double-walk: only recurse blocks that can
+                        # contain With statements
+                        if any(
+                            isinstance(s, (ast.With, ast.AsyncWith, ast.If,
+                                           ast.For, ast.While, ast.Try))
+                            for s in sub_body
+                        ):
+                            visit(sub_body, held)
+                for handler in getattr(node, "handlers", []) or []:
+                    visit(handler.body, held)
+
+        visit(fn.body, held)
+
+    # -- pass 3: fixpoint + cycle detection ----------------------------------
+    def build_edges(self) -> None:
+        may: Dict[str, Set[str]] = {
+            q: set(i.acquires) for q, i in self.fns.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.fns.items():
+                cur = may[q]
+                before = len(cur)
+                for callee in info.calls:
+                    cur |= may.get(callee, set())
+                if len(cur) != before:
+                    changed = True
+        for q, info in self.fns.items():
+            for held, lid, line in info.held_acquires:
+                self.edges.setdefault((held, lid), (info.relpath, line))
+            for held, callee, line in info.held_calls:
+                for lid in may.get(callee, ()):
+                    self.edges.setdefault((held, lid), (info.relpath, line))
+
+    def find_cycles(self) -> List[Violation]:
+        graph: Dict[str, Set[str]] = defaultdict(set)
+        for (a, b), _site in self.edges.items():
+            if a == b:
+                # same-identity re-acquire: reentrancy, not ordering. Only a
+                # non-reentrant Lock is a deadlock against ITSELF.
+                if self.locks.get(a) == "Lock":
+                    graph[a].add(b)
+                continue
+            graph[a].add(b)
+        out: List[Violation] = []
+        # DFS cycle detection with path recovery
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in set(graph) | {
+            b for bs in graph.values() for b in bs
+        }}
+        stack: List[str] = []
+        seen_cycles: Set[frozenset] = set()
+
+        def dfs(n: str) -> None:
+            color[n] = GRAY
+            stack.append(n)
+            for m in graph.get(n, ()):
+                if m == n:
+                    key = frozenset([n])
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        site = self.edges[(n, n)]
+                        out.append(Violation(
+                            site[0], site[1], "lock-order",
+                            f"non-reentrant lock {n} re-acquired while "
+                            "held (self-deadlock)",
+                        ))
+                    continue
+                if color[m] == GRAY:
+                    i = stack.index(m)
+                    cyc = stack[i:] + [m]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        site = self.edges.get(
+                            (cyc[0], cyc[1])
+                        ) or self.edges.get((cyc[-2], cyc[-1])) or ("?", 0)
+                        out.append(Violation(
+                            site[0], site[1], "lock-order",
+                            "lock acquisition cycle: "
+                            + " -> ".join(cyc),
+                        ))
+                elif color[m] == WHITE:
+                    dfs(m)
+            stack.pop()
+            color[n] = BLACK
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                dfs(n)
+        return out
+
+
+# -- rule P: persist-before-transmit -----------------------------------------
+
+
+def check_persist_before_transmit(
+    relpath: str, tree: ast.Module, src_lines: List[str]
+) -> List[Violation]:
+    out: List[Violation] = []
+
+    def scan_fn(fn) -> None:
+        if fn.name in TRANSMIT_WHITELIST:
+            return
+        journal_lines: List[int] = []
+        transports: List[Tuple[int, str]] = []
+        # prune nested defs: their sends are their OWN responsibility
+        # (scan_fn sees them via walk()), not this function's
+        nested: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        nested.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name in JOURNAL_CALLEES:
+                journal_lines.append(node.lineno)
+            elif name in TRANSPORT_CALLEES:
+                # only SELF-owned transports count: self._send(...) — a
+                # nested def named _send, or a local callable, is the
+                # transport's own definition, not a use
+                if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.value.id == "self":
+                    transports.append((node.lineno, name))
+        if not transports:
+            return
+        first_journal = min(journal_lines) if journal_lines else None
+        for line, name in transports:
+            if _line_allowed(src_lines, line, "persist-before-transmit"):
+                continue
+            if first_journal is None or line < first_journal:
+                out.append(Violation(
+                    relpath, line, "persist-before-transmit",
+                    f"transport call self.{name}(...) in {fn.name}() is "
+                    "not dominated by a journal record "
+                    "(_durable_send/_native_send/journal.record)",
+                ))
+
+    # transport-definition sites (functions ASSIGNED to self._send, e.g. the
+    # _no_send stub) never transmit — skip nested defs by walking only
+    # top-level functions/methods
+    def walk(body) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(node)
+                walk(node.body)
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body)
+
+    walk(tree.body)
+    return out
+
+
+# -- driver ------------------------------------------------------------------
+
+
+def is_deterministic_module(relpath_in_pkg: str) -> bool:
+    if relpath_in_pkg in DETERMINISTIC_FILES:
+        return True
+    return any(
+        relpath_in_pkg.startswith(p) for p in DETERMINISTIC_PREFIXES
+    )
+
+
+def run(root: str) -> int:
+    pkg_root = os.path.join(root, PACKAGE)
+    if not os.path.isdir(pkg_root):
+        print(f"check_invariants: no {PACKAGE}/ under {root}",
+              file=sys.stderr)
+        return 2
+    violations: List[Violation] = []
+    allowed_count = 0
+    lock_checker = LockOrderChecker()
+    parsed: List[Tuple[str, str, ast.Module, List[str]]] = []
+
+    for dirpath, _dirs, files in sorted(os.walk(pkg_root)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel_in_pkg = os.path.relpath(full, pkg_root).replace(
+                os.sep, "/"
+            )
+            relpath = f"{PACKAGE}/{rel_in_pkg}"
+            try:
+                with open(full, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=full)
+            except SyntaxError as exc:
+                print(f"check_invariants: parse error in {relpath}: {exc}",
+                      file=sys.stderr)
+                return 2
+            src_lines = src.splitlines()
+            allowed_count += sum(
+                1 for line in src_lines if ALLOW_MARK in line
+            )
+            parsed.append((relpath, rel_in_pkg, tree, src_lines))
+            lock_checker.discover(relpath, tree)
+            lock_checker.register_functions(relpath, tree)
+
+    for relpath, rel_in_pkg, tree, src_lines in parsed:
+        if is_deterministic_module(rel_in_pkg):
+            violations += check_determinism(relpath, tree, src_lines)
+        if rel_in_pkg.startswith("consensus/"):
+            violations += check_persist_before_transmit(
+                relpath, tree, src_lines
+            )
+        lock_checker.analyze(relpath, tree, src_lines)
+
+    lock_checker.build_edges()
+    violations += lock_checker.find_cycles()
+
+    for v in sorted(violations, key=lambda v: (v.path, v.line)):
+        print(v)
+    n_locks = len(lock_checker.locks)
+    n_edges = len(lock_checker.edges)
+    print(
+        f"check_invariants: {len(violations)} violation(s), "
+        f"{n_locks} lock identities, {n_edges} hold-acquire edges, "
+        f"{allowed_count} lint-allow line(s)",
+        file=sys.stderr,
+    )
+    return 1 if violations else 0
+
+
+def main(argv: List[str]) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    return run(root)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
