@@ -8,13 +8,16 @@ PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-storage \
-	test-observability test-sync test-pipeline test-exec test-trie native \
-	bench bench-gate lint sanitize sanitize-tsan
+	test-observability test-sync test-pipeline test-exec test-trie \
+	test-mesh native bench bench-gate lint sanitize sanitize-tsan
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
-# TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend
+# TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend.
+# mesh-marked tests are excluded: their shard_map compiles belong to the
+# dedicated mesh job ("make test-mesh") so a kernel-shard retry never
+# re-pays them
 test-kernel:
-	$(PYTEST) $(PYTEST_ARGS) -m kernel
+	$(PYTEST) $(PYTEST_ARGS) -m "kernel and not mesh"
 
 # everything that is neither a kernel test nor a fault-injection run:
 # consensus, storage, network, RPC, node lifecycle — the quick sanity
@@ -23,9 +26,10 @@ test-fast:
 	$(PYTEST) $(PYTEST_ARGS) -m "not kernel and not chaos and not crash and not slow"
 
 # fault injection + durability: seeded loss/partition chaos matrices,
-# crash-point injection, SIGKILL-restart recovery
+# crash-point injection, SIGKILL-restart recovery ("not mesh": the
+# slow-marked mesh differentials run in their own job, not here)
 test-chaos:
-	$(PYTEST) $(PYTEST_ARGS) -m "chaos or crash or slow"
+	$(PYTEST) $(PYTEST_ARGS) -m "(chaos or crash or slow) and not mesh"
 
 # durable-store engines: LSM differential/crash/compaction tests, trie +
 # state snapshots, crash-point matrix, fsck, CLI db verbs. Overlaps the
@@ -70,6 +74,17 @@ test-exec:
 # StateManager streamed commit
 test-trie:
 	$(PYTEST) $(PYTEST_ARGS) -m trie
+
+# multi-device mesh crypto: the shard_mapped era pipeline on 8 forced
+# virtual host devices (tests/test_mesh.py + test_warmup.py) — the
+# mesh-vs-single-device differential, consensus-on-mesh end-to-end, mesh
+# warmup through the persistent kernel cache. Includes the slow-marked
+# differentials; the CI 'mesh' job runs exactly this slice so the
+# skip-on-unsupported guard can never hide the suite everywhere
+test-mesh:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PYTEST) $(PYTEST_ARGS) -m mesh
 
 test:
 	$(PYTEST) $(PYTEST_ARGS)
@@ -127,3 +142,7 @@ bench-gate:
 		| tail -n 1 > /tmp/lachain_commit_now.json
 	python benchmarks/compare.py benchmarks/results_r10.json \
 		/tmp/lachain_commit_now.json --min-threshold-pct 25
+	python benchmarks/bench_consensus_sim.py --n 7 --eras 2 --txs 64 \
+		--mesh-devices 8 | tail -n 1 > /tmp/lachain_mesh_now.json
+	python benchmarks/compare.py benchmarks/MULTICHIP_sim_gate.json \
+		/tmp/lachain_mesh_now.json --min-threshold-pct 60

@@ -54,6 +54,18 @@ def main() -> None:
         "commit; 0 = strictly sequential eras",
     )
     ap.add_argument(
+        "--mesh-devices",
+        type=int,
+        default=0,
+        help="run the TPKE era batches on a ('slot' x 'share') device mesh "
+        "(parallel/mesh.MeshEraPipeline): forces the TPU backend + device "
+        "routing for every era batch, and — when the platform is CPU — "
+        "forces this many virtual host devices via XLA_FLAGS. On real "
+        "multi-device hardware the mesh is selected automatically; this "
+        "flag exists to exercise the mesh path anywhere. 0 = default "
+        "backend selection",
+    )
+    ap.add_argument(
         "--overhead-check",
         action="store_true",
         help="after the timed eras, re-run the same era count with the "
@@ -67,10 +79,39 @@ def main() -> None:
         # legitimately run ~30M+ deliveries)
         args.max_messages = max(20_000_000, 4_000 * args.n * args.n)
 
+    if args.mesh_devices > 0:
+        # BEFORE any jax import: route era batches to the device pipeline
+        # (the mesh is selected whenever >1 device is visible) and, on
+        # CPU-only hosts, split the host platform into virtual devices
+        os.environ["LACHAIN_TPU_BACKEND"] = "tpu"
+        os.environ.setdefault("LTPU_TPU_MIN_LANES", "1")
+        if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count="
+                    f"{args.mesh_devices}"
+                ).strip()
+
     from lachain_tpu.core.devnet import Devnet
     from lachain_tpu.core.types import Transaction, sign_transaction
     from lachain_tpu.crypto import ecdsa
     from lachain_tpu.utils import metrics, tracing
+
+    if args.mesh_devices > 0:
+        # precompile the mesh-shaped era kernels off the clock (one entry
+        # per (mesh shape, s_pad, k_pad) tier, persisted via kernel_cache)
+        from lachain_tpu.crypto.provider import get_backend
+        from lachain_tpu.crypto.warmup import warmup_era_kernels
+
+        print(
+            f"warming mesh era kernels for N={args.n} ...", file=sys.stderr
+        )
+        t = warmup_era_kernels(args.n, backend=get_backend())
+        if t is not None:
+            t.join()
 
     n = args.n
     f = (n - 1) // 3
@@ -153,18 +194,27 @@ def main() -> None:
 
     # flight-recorder era phase attribution for the timed eras (merged
     # Python spans + native engine rings; see tracing.era_report)
-    phase_report = {
-        ent["era"]: {
+    phase_report = {}
+    mesh_utils = []
+    for ent in tracing.era_report()["eras"]:
+        if not (1 <= ent["era"] <= args.eras):
+            continue
+        dev = ent.get("device") or {}
+        phase_report[ent["era"]] = {
             "wall_s": ent["wall_s"],
             **ent["phases_s"],
             "idle_s": ent["idle_s"],
             # wall time shared with other in-flight eras (era pipelining);
             # 0.0 everywhere in a sequential run
             "overlap_s": ent.get("overlap_s", 0.0),
+            # per-device utilization row (mesh path): device-busy window
+            # (kernel dispatch -> ready) vs era wall + all_gather traffic
+            "device_busy_s": dev.get("busy_s", 0.0),
+            "device_util": dev.get("util", 0.0),
+            "allgather_mb": dev.get("allgather_mb", 0.0),
         }
-        for ent in tracing.era_report()["eras"]
-        if 1 <= ent["era"] <= args.eras
-    }
+        if dev.get("mesh_devices"):
+            mesh_utils.append(dev.get("util", 0.0))
 
     trace_overhead_pct = None
     if args.overhead_check:
@@ -216,6 +266,18 @@ def main() -> None:
                 " * (N-1)/N; block_execute timed via utils.metrics"
                 " 'block_execute' (every node executes every block in-sim,"
                 " a real node executes once)",
+                # mesh crypto path (--mesh-devices): device count, last-call
+                # pad waste, and the floor of per-era device utilization —
+                # the number the MULTICHIP bench gate tracks
+                "mesh_devices": int(
+                    metrics.gauge_value("mesh_devices") or 0
+                ),
+                "mesh_pad_waste_fraction": metrics.gauge_value(
+                    "mesh_pad_waste_fraction"
+                ),
+                "mesh_device_util_floor": round(min(mesh_utils), 4)
+                if mesh_utils
+                else None,
                 # flight recorder: where inside each timed era the time went
                 "era_phase_report_s": phase_report,
                 # ON-vs-OFF min-era delta when --overhead-check ran

@@ -45,8 +45,10 @@ LATENCY_FIELDS = (
 )
 
 # throughput-shaped side fields compared higher-is-better when both runs
-# report them (bench_storage_commit rows carry committed tx/s)
-THROUGHPUT_FIELDS = ("tx_per_s_commit",)
+# report them (bench_storage_commit rows carry committed tx/s; the mesh
+# bench rows carry the per-era device-utilization floor — a drop means the
+# chips idled more of the era wall than the MULTICHIP baseline allows)
+THROUGHPUT_FIELDS = ("tx_per_s_commit", "mesh_device_util_floor")
 
 
 def load_result(path: str) -> dict:
@@ -114,6 +116,14 @@ def compare(base: dict, cur: dict, floor: float) -> Tuple[int, str]:
         return 2, (
             f"metric mismatch: baseline is {base['metric']!r}, "
             f"current is {cur['metric']!r}"
+        )
+    # mesh runs are only comparable against a baseline recorded on the
+    # same mesh width — utilization and per-era walls both scale with it
+    if (base.get("mesh_devices") or 0) != (cur.get("mesh_devices") or 0):
+        return 2, (
+            f"mesh_devices mismatch: baseline ran on "
+            f"{base.get('mesh_devices') or 0} devices, current on "
+            f"{cur.get('mesh_devices') or 0}"
         )
     allowed = threshold_pct(base, cur, floor)
     rows = []

@@ -162,7 +162,18 @@ class TpkeEraBatcher:
             "tpke.flush", cat="crypto", submissions=len(batch)
         )
         padded = 0
+        # two-phase chunk overlap: when the backend exposes the async era
+        # call AND its pipeline double-buffers dispatches (the mesh path),
+        # dispatch up to `depth` chunks before finishing the oldest — chunk
+        # e+1's host marshal + device_put overlaps chunk e's sharded kernel
+        era_async = getattr(backend, "tpke_era_verify_combine_async", None)
+        depth = (
+            int(getattr(backend, "era_dispatch_depth", 1))
+            if era_async is not None
+            else 1
+        )
         try:
+            inflight: List[Tuple[int, Callable]] = []
             off = 0
             while off < len(flat_jobs):
                 # chunk bounds the device S_pad shape AND stays within one
@@ -176,9 +187,19 @@ class TpkeEraBatcher:
                 ):
                     end += 1
                 padded += _pow2_at_least(end - off)
-                out = era_fn(flat_jobs[off:end], vks)
-                results[off : off + len(out)] = out
+                if depth > 1:
+                    inflight.append((off, era_async(flat_jobs[off:end], vks)))
+                    if len(inflight) >= depth:
+                        o, fin = inflight.pop(0)
+                        out = fin()
+                        results[o : o + len(out)] = out
+                else:
+                    out = era_fn(flat_jobs[off:end], vks)
+                    results[off : off + len(out)] = out
                 off = end
+            for o, fin in inflight:
+                out = fin()
+                results[o : o + len(out)] = out
         except Exception:
             tracing.end(sid, outcome="exception")
             # device path broken mid-flush: liveness beats acceleration —

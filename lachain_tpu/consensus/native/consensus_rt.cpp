@@ -222,6 +222,15 @@ static std::vector<std::string> rs_encode(const std::string& data, int k,
   prefixed.push_back((char)(len >> 8));
   prefixed.push_back((char)len);
   prefixed += data;
+  if (n > 255) {
+    // GF(2^8) RS has only 255 distinct evaluation points; past that the
+    // RBC degrades to whole-payload replication — every shard carries the
+    // full length-prefixed payload (bandwidth n x |v| instead of the coded
+    // optimum; ECHO/READY thresholds and the Merkle commitment are
+    // unchanged). Mirrors ops/rs.py::encode; a GF(2^16) codec is the
+    // planned upgrade (ROADMAP item 1).
+    return std::vector<std::string>((size_t)n, prefixed);
+  }
   size_t shard_size = (prefixed.size() + k - 1) / k;
   if (shard_size == 0) shard_size = 1;
   prefixed.resize((size_t)k * shard_size, '\0');
@@ -292,6 +301,21 @@ static bool rs_decode(const std::vector<std::string>& shards, int k,
   // end of the shorter shard's buffer
   for (int i = 1; i < k; i++)
     if (shards[have_idx[i]].size() != size) return false;
+  if (n > 255) {
+    // replication mode (see rs_encode): every shard IS the prefixed
+    // payload; decode from the first one. Shards that disagree with the
+    // committed Merkle root are rejected at receive time, and the
+    // re-encode check in try_decode catches a root over mixed payloads.
+    const std::string& flat = shards[have_idx[0]];
+    if (flat.size() < 4) return false;
+    uint32_t length = ((uint32_t)(uint8_t)flat[0] << 24) |
+                      ((uint32_t)(uint8_t)flat[1] << 16) |
+                      ((uint32_t)(uint8_t)flat[2] << 8) |
+                      (uint32_t)(uint8_t)flat[3];
+    if (length > flat.size() - 4) return false;
+    out = flat.substr(4, length);
+    return true;
+  }
   // Vandermonde rows [x^0 .. x^{k-1}] at x = idx+1
   std::vector<uint8_t> mat((size_t)k * k);
   for (int r = 0; r < k; r++) {
@@ -369,13 +393,18 @@ struct Entry {
 };
 
 struct Bits {
-  uint64_t w[4] = {0, 0, 0, 0};
+  // 512-bit membership mask — sized for the engine's N <= 512 hard cap
+  // (rt_new rejects larger). Bits::set past the array end was silent
+  // memory corruption for any validator index >= 256 (the old w[4]),
+  // which is where N=512 eras crashed.
+  uint64_t w[8] = {0, 0, 0, 0, 0, 0, 0, 0};
   inline void set(int i) { w[i >> 6] |= 1ULL << (i & 63); }
   inline void clr(int i) { w[i >> 6] &= ~(1ULL << (i & 63)); }
   inline bool test(int i) const { return (w[i >> 6] >> (i & 63)) & 1; }
   inline int count() const {
-    return __builtin_popcountll(w[0]) + __builtin_popcountll(w[1]) +
-           __builtin_popcountll(w[2]) + __builtin_popcountll(w[3]);
+    int c = 0;
+    for (int i = 0; i < 8; i++) c += __builtin_popcountll(w[i]);
+    return c;
   }
 };
 
@@ -2061,6 +2090,9 @@ int lt_crt_version() { return 4; }
 // table bootstrap (gf_init) is guarded by a non-atomic static flag.
 void* rt_new(int n, int f, int mode, uint32_t repeat_ppm, uint64_t seed,
              int era0) {
+  // hard cap: Bits membership masks are 512-bit. A too-large N must be a
+  // clean construction failure, not silent mask corruption mid-era.
+  if (n < 1 || n > 512 || f < 0) return nullptr;
   return new Engine(n, f, mode, repeat_ppm, seed, era0);
 }
 

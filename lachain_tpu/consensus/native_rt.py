@@ -820,6 +820,11 @@ class NativeSimulatedNetwork:
             seed,
             era,
         )
+        if not self._h:
+            raise ValueError(
+                f"native engine rejected N={self.n}: rt_new supports "
+                "1 <= N <= 512 (512-bit membership masks)"
+            )
         self._era_engines[era] = self._h
         for v in self.muted:
             self._lib.rt_mute(self._h, v)
@@ -918,6 +923,11 @@ class NativeSimulatedNetwork:
             self.n, self.f, self._mode_i, self._repeat_ppm,
             self._era_seed(era), era,
         )
+        if not h:
+            raise ValueError(
+                f"native engine rejected N={self.n}: rt_new supports "
+                "1 <= N <= 512 (512-bit membership masks)"
+            )
         for v in self.muted:
             self._lib.rt_mute(h, v)
         self._lib.rt_set_coin_need(h, self._coin_need)

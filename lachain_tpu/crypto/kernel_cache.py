@@ -187,6 +187,70 @@ def call(jit_fn, name: str, *args, **statics):
     return compiled(*args)
 
 
+def _mesh_tag(mesh) -> str:
+    shape = "x".join(f"{k}{v}" for k, v in dict(mesh.shape).items())
+    return f"@mesh[{shape}]dev{mesh.devices.size}"
+
+
+def call_mesh(jit_fn, name: str, mesh, *args):
+    """Run a shard_mapped `jit_fn(*args)` through the persistent cache.
+
+    The mesh variant of call(): unlike the single-chip path it does NOT
+    bypass on multi-device platforms — sharded executables serialize and
+    deserialize fine when their args carry NamedShardings (round-6 probe:
+    a shard_mapped era kernel round-trips on the 8-virtual-device CPU
+    platform). The mesh shape joins the cache key, and a deserialized
+    executable that rejects this process's device assignment falls back to
+    a recompile instead of failing the era."""
+    key = _key(name + _mesh_tag(mesh), args, {})
+    compiled = _memo.get(key)
+    if compiled is None:
+        with _lock_for(key):
+            compiled = _memo.get(key)
+            if compiled is None:
+                compiled = _disk_load(key)
+                if compiled is not None:
+                    try:
+                        out = compiled(*args)
+                    except Exception:
+                        logger.exception(
+                            "mesh cache entry %s incompatible with this "
+                            "device assignment; recompiling", key
+                        )
+                        compiled = None
+                    else:
+                        metrics.inc(
+                            "kernel_cache_requests", labels={"tier": "disk"}
+                        )
+                        with _lock:
+                            _memo[key] = compiled
+                        return out
+                metrics.inc(
+                    "kernel_cache_requests", labels={"tier": "compile"}
+                )
+                t0 = metrics.monotonic()
+                compiled = jit_fn.lower(*args).compile()
+                metrics.observe_hist(
+                    "kernel_cache_compile_seconds",
+                    metrics.monotonic() - t0,
+                    buckets=(0.1, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0),
+                )
+                _disk_store(key, compiled)
+                with _lock:
+                    _memo[key] = compiled
+                return compiled(*args)
+    metrics.inc("kernel_cache_requests", labels={"tier": "memo"})
+    try:
+        return compiled(*args)
+    except Exception:
+        # a memoized executable can go stale if the device set changed
+        # under us (tests resetting platforms); drop it and run the jit
+        logger.exception("memoized mesh kernel %s failed; re-jitting", key)
+        with _lock:
+            _memo.pop(key, None)
+        return jit_fn(*args)
+
+
 def warm(jit_fn, name: str, *args, **statics) -> bool:
     """Ensure the executable for this shape is memoized (disk or compile)
     WITHOUT running it. Returns True if it came from disk."""

@@ -165,6 +165,16 @@ class TpuBackend:
                 self._pipeline = HostEraPipeline(self._host)
         return self._pipeline
 
+    @property
+    def era_dispatch_depth(self) -> int:
+        """How many era-batch dispatches may be in flight at once: the mesh
+        pipeline's host-staging double buffer admits MAX_INFLIGHT; every
+        synchronous pipeline is 1 (dispatch == run)."""
+        try:
+            return int(getattr(self._get_pipeline(), "MAX_INFLIGHT", 1))
+        except Exception:
+            return 1
+
     def _get_ts_pipeline(self):
         if self._ts_pipeline is None:
             import os
@@ -327,10 +337,66 @@ class TpuBackend:
         metrics.inc("crypto_tpu_era_kernel_calls")
         return results
 
+    def tpke_era_verify_combine_async(
+        self,
+        jobs: Sequence[EraSlotJob],
+        verification_keys,
+        rng=secrets,
+    ):
+        """Two-phase tpke_era_verify_combine: does the host marshal +
+        kernel dispatch now and returns a `finish()` closure producing the
+        same per-job results.
+
+        With the mesh pipeline the kernel runs asynchronously between
+        dispatch and finish, so a caller holding several era chunks
+        (consensus/crypto_batcher.flush) overlaps chunk e+1's host marshal
+        with chunk e's sharded kernel — the double-buffer contract bounds
+        in-flight dispatches to MeshEraPipeline.MAX_INFLIGHT. On host/
+        Pallas pipelines the work happens at dispatch and finish() just
+        returns it."""
+        if not jobs:
+            return lambda: []
+        with metrics.measure("crypto_tpu_era_verify_combine"):
+            fin = self._dispatch_era_batch(
+                jobs=jobs,
+                rows=[j.u_by_validator for j in jobs],
+                lags=[j.lagrange_row for j in jobs],
+                y_points=self._stable_y_points(verification_keys),
+                inf_point=bls.G1_INF,
+                pipeline_getter=self._get_pipeline,
+                host_pipeline_getter=self._get_host_pipeline,
+                pairs_for=lambda job, agg: [
+                    (agg[0], job.h),
+                    (bls.g1_neg(agg[1]), job.w),
+                ],
+                rng=rng,
+            )
+
+        def finish():
+            with metrics.measure("crypto_tpu_era_verify_combine"):
+                results = fin()
+            self.era_calls += 1
+            self.era_slots_total += len(jobs)
+            metrics.inc("crypto_tpu_era_kernel_calls")
+            return results
+
+        return finish
+
     def _run_era_batch(
         self, jobs, rows, lags, y_points, inf_point, pipeline_getter,
         host_pipeline_getter, pairs_for, rng,
     ) -> List[Tuple[bool, Optional[tuple]]]:
+        return self._dispatch_era_batch(
+            jobs=jobs, rows=rows, lags=lags, y_points=y_points,
+            inf_point=inf_point, pipeline_getter=pipeline_getter,
+            host_pipeline_getter=host_pipeline_getter, pairs_for=pairs_for,
+            rng=rng,
+        )()
+
+    def _dispatch_era_batch(
+        self, jobs, rows, lags, y_points, inf_point, pipeline_getter,
+        host_pipeline_getter, pairs_for, rng,
+    ):
         """Shared engine for both era ops: mask absent lanes, pad the slot
         axis to a power of two with fully-masked dummy slots (bounds the
         static kernel shapes to log2(N)+1 per K), run the pipeline, then
@@ -338,12 +404,17 @@ class TpuBackend:
         pairing pairs encoding that slot's verification equality; each
         slot's equality is independently randomized by its own RLC
         coefficients, so a pairing product over any subset is a sound
-        batch check for that subset."""
+        batch check for that subset.
+
+        Returns a finish() closure: pipelines exposing `dispatch_era`
+        (parallel/mesh.MeshEraPipeline) run their kernel asynchronously
+        until finish() blocks; synchronous pipelines complete at dispatch
+        and finish() just post-processes."""
         from ..ops.verify import _pow2_at_least
 
         s = len(jobs)
         if s == 0:
-            return []
+            return lambda: []
         k = len(y_points)
         for row, lag in zip(rows, lags):
             if len(row) != k or len(lag) != k:
@@ -382,26 +453,36 @@ class TpuBackend:
             buckets=(0.0, 0.1, 0.2, 0.3, 0.4, 0.5),
         )
         t0 = metrics.monotonic()
-        aggs, _rlc = pipeline.run_era(slots, y_points, rng, masks=masks)
-        metrics.observe_hist(
-            "crypto_tpu_era_pipeline_seconds",
-            metrics.monotonic() - t0,
-            labels={"path": path},
-        )
+        dispatch = getattr(pipeline, "dispatch_era", None)
+        if dispatch is not None:
+            pipeline_fin = dispatch(slots, y_points, rng, masks=masks)
+        else:
+            ran = pipeline.run_era(slots, y_points, rng, masks=masks)
+            pipeline_fin = lambda: ran  # noqa: E731
 
-        def group_ok(idx: List[int]) -> bool:
-            pairs = []
-            for i in idx:
-                pairs.extend(pairs_for(jobs[i], aggs[i]))
-            return self._host.pairing_check(pairs)
+        def finish():
+            aggs, _rlc = pipeline_fin()
+            metrics.observe_hist(
+                "crypto_tpu_era_pipeline_seconds",
+                metrics.monotonic() - t0,
+                labels={"path": path},
+            )
 
-        from .provider import batch_bisect_verify
+            def group_ok(idx: List[int]) -> bool:
+                pairs = []
+                for i in idx:
+                    pairs.extend(pairs_for(jobs[i], aggs[i]))
+                return self._host.pairing_check(pairs)
 
-        ok_flags = batch_bisect_verify(group_ok, s)
-        return [
-            (ok, aggs[i][2] if ok else None)
-            for i, ok in enumerate(ok_flags)
-        ]
+            from .provider import batch_bisect_verify
+
+            ok_flags = batch_bisect_verify(group_ok, s)
+            return [
+                (ok, aggs[i][2] if ok else None)
+                for i, ok in enumerate(ok_flags)
+            ]
+
+        return finish
 
     @metrics.timed("crypto_tpu_ts_era_verify_combine")
     def ts_era_verify_combine(

@@ -67,6 +67,25 @@ def warmup_era_kernels(
 
         k = n_validators
         todo = list(shapes) if shapes is not None else era_warmup_shapes(k)
+        # mesh pipelines pad the (pow2) slot tiers again to a multiple of
+        # the 'slot' mesh axis, collapsing the small tiers onto one padded
+        # kernel shape — dedupe so warmup compiles each (mesh shape, s_pad,
+        # k_pad) entry exactly once (through kernel_cache.call_mesh, which
+        # also persists it to disk for the next process)
+        try:
+            pipe = backend._get_pipeline()
+        except Exception:
+            pipe = None
+        if pipe is not None and hasattr(pipe, "padded_shape"):
+            seen: set = set()
+            deduped = []
+            for s in todo:
+                ps = pipe.padded_shape(s, k)
+                if ps in seen:
+                    continue
+                seen.add(ps)
+                deduped.append(s)
+            todo = deduped
         for s in todo:
             try:
                 jobs = [

@@ -76,8 +76,16 @@ def encode(data: bytes, k: int, n: int) -> List[bytes]:
     zero-padded to k * shard_size; shard j holds coefficient j of each column
     polynomial. Returns n shards of equal size.
     """
-    assert 0 < k <= n < 256
+    assert 0 < k <= n
     prefixed = len(data).to_bytes(4, "big") + data
+    if n > 255:
+        # GF(2^8) has only 255 distinct evaluation points. Past that the
+        # codec degrades to whole-payload replication: every shard is the
+        # full prefixed payload (bandwidth n x |v| instead of the coded
+        # optimum; thresholds and Merkle commitments unchanged). Mirrors
+        # consensus_rt.cpp::rs_encode; GF(2^16) coding is the planned
+        # upgrade (ROADMAP item 1).
+        return [prefixed] * n
     shard_size = (len(prefixed) + k - 1) // k
     padded = prefixed + b"\x00" * (k * shard_size - len(prefixed))
     coeffs = np.frombuffer(padded, dtype=np.uint8).reshape(k, shard_size)
@@ -103,13 +111,23 @@ def decode(shards: Sequence[Optional[bytes]], k: int) -> Optional[bytes]:
     if len(have) < k:
         return None
     have = have[:k]
-    xs = [_eval_points(n)[i] for i, _ in have]
     size = len(have[0][1])
     # adversarial-input guard: a malicious proposer can commit a Merkle
     # root over DIFFERENT-SIZED shards (each with a valid branch); mixed
     # sizes must be a clean decode failure, not a crash (np.stack raises)
     if any(len(s) != size for _, s in have):
         return None
+    if n > 255:
+        # replication mode (see encode): every shard IS the prefixed
+        # payload; decode from the first one
+        flat = have[0][1]
+        if len(flat) < 4:
+            return None
+        length = int.from_bytes(flat[:4], "big")
+        if length > len(flat) - 4:
+            return None
+        return flat[4 : 4 + length]
+    xs = [_eval_points(n)[i] for i, _ in have]
     mat = np.zeros((k, k), dtype=np.uint8)  # Vandermonde rows [x^0 .. x^{k-1}]
     for r, x in enumerate(xs):
         v = 1

@@ -184,13 +184,15 @@ class GlvEraPipeline:
         self._y_cache[key] = (y_points, tables)
         return tables
 
-    def run_era(self, slots, y_points, rng) -> Tuple[list, list]:
+    def run_era(self, slots, y_points, rng, masks=None) -> Tuple[list, list]:
         """slots: list of (u_list, lagrange_list) per ACS slot, where u_list
         holds the K decryption-share points and lagrange_list the combine
         coefficients (0 for shares outside the subset). y_points: the K
-        verification keys. Returns (per-slot (u_agg, y_agg, combined) oracle
-        points, rlc coefficients used) — the caller finishes with the grand
-        pairing check against its H/W points.
+        verification keys. masks: optional S x K booleans zeroing the RLC
+        coefficient of absent-share lanes (era_rlc semantics, shared with
+        the host/Pallas/mesh pipelines). Returns (per-slot (u_agg, y_agg,
+        combined) oracle points, rlc coefficients used) — the caller
+        finishes with the grand pairing check against its H/W points.
         """
         import jax.numpy as jnp
 
@@ -200,10 +202,7 @@ class GlvEraPipeline:
             [msm.g1_to_device_loose(u_list) for u_list, _ in slots]
         )
         y_tables = self.y_device(y_points)
-        rlc = [
-            [rng.randbelow((1 << 64) - 1) + 1 for _ in range(k)]
-            for _ in range(s)
-        ]
+        rlc = era_rlc(slots, k, rng, masks)
         rlc64, rlc_d, lag1, lag2 = msm.era_digits(
             rlc, [lag_list for _, lag_list in slots]
         )

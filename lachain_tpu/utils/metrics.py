@@ -212,6 +212,15 @@ def counters_with_prefix(prefix: str) -> Dict[Tuple[str, tuple], float]:
         }
 
 
+# Mesh crypto gauges published by parallel/mesh.py (MeshEraPipeline):
+#   mesh_devices             devices in the era mesh ('slot' x 'share')
+#   mesh_pad_waste_fraction  fraction of the padded (S_pad x K_pad) kernel
+#                            grid burnt on filler lanes for the LAST era
+#                            call — pad_pow2 can inflate K well past K_live
+#                            for non-power-of-two validator counts; tune
+#                            with the DEPLOY.md "Multi-device crypto"
+#                            runbook (pad-waste tuning)
+
 # LSM read-path gauges published by storage/lsm.py (LsmKV.publish_metrics):
 #   lsm_bloom_hits       lookups a table's bloom filter ruled out (the block
 #                        fetch the filter saved)

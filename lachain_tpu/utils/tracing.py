@@ -529,6 +529,15 @@ def era_report(
             continue
         per_era_iv[int(era)].append((phase, d["start"], d["end"]))
 
+    # mesh device-busy windows (parallel/mesh.MeshEraPipeline spans the
+    # kernel dispatch -> result-ready interval as "mesh.device"): these are
+    # era-agnostic — the pipeline serves every validator's chunks — so they
+    # attribute to eras by time overlap with each era window
+    mesh_spans = [
+        d for d in spans
+        if d["name"] == "mesh.device" and d["end"] is not None
+    ]
+
     dispatch: Dict[int, Dict[str, float]] = {}
     for ev in native:
         era = (ev.get("args") or {}).get("era")
@@ -578,6 +587,36 @@ def era_report(
             phases[phase] += secs
         attributed = sum(phases.values())
         idle = max(wall - attributed, 0.0)
+        # per-device utilization row: union of mesh.device (dispatch ->
+        # ready) windows clipped to this era, all_gather bytes pro-rated by
+        # the clipped fraction. busy/wall is an upper bound on device
+        # utilization (the ready edge is observed when the caller blocks)
+        dev_iv = []
+        dev_mb = 0.0
+        dev_n = 0
+        for d in mesh_spans:
+            cs, ce = max(d["start"], lo), min(d["end"], hi)
+            if ce <= cs:
+                continue
+            dev_iv.append((cs, ce))
+            dur = d["end"] - d["start"]
+            if dur > 0:
+                dev_mb += float(
+                    d["args"].get("allgather_mb", 0.0)
+                ) * (ce - cs) / dur
+            dev_n = max(dev_n, int(d["args"].get("devices", 0)))
+        dev_iv.sort()
+        busy = 0.0
+        cur_s = cur_e = None
+        for cs, ce in dev_iv:
+            if cur_e is None or cs > cur_e:
+                if cur_e is not None:
+                    busy += cur_e - cur_s
+                cur_s, cur_e = cs, ce
+            else:
+                cur_e = max(cur_e, ce)
+        if cur_e is not None:
+            busy += cur_e - cur_s
         eras.append(
             {
                 "era": era,
@@ -589,6 +628,12 @@ def era_report(
                 "coverage": round(
                     (attributed + idle) / wall, 4
                 ) if wall > 0 else 1.0,
+                "device": {
+                    "busy_s": round(busy, 6),
+                    "util": round(busy / wall, 4) if wall > 0 else 0.0,
+                    "allgather_mb": round(dev_mb, 3),
+                    "mesh_devices": dev_n,
+                },
             }
         )
     return {"eras": eras, "phases": list(PHASES)}
@@ -598,13 +643,21 @@ def era_report_table(report: Optional[dict] = None) -> str:
     """Plain-text per-era phase table (CLI `trace --era-report`)."""
     if report is None:
         report = era_report()
-    cols = ["era", "wall_s"] + list(PHASES) + ["idle_s", "overlap_s"]
+    cols = (
+        ["era", "wall_s"] + list(PHASES)
+        + ["idle_s", "overlap_s", "dev_util"]
+    )
     rows = [cols]
     for ent in report["eras"]:
+        dev = ent.get("device") or {}
         rows.append(
             [str(ent["era"]), f"{ent['wall_s']:.3f}"]
             + [f"{ent['phases_s'][p]:.3f}" for p in PHASES]
-            + [f"{ent['idle_s']:.3f}", f"{ent.get('overlap_s', 0.0):.3f}"]
+            + [
+                f"{ent['idle_s']:.3f}",
+                f"{ent.get('overlap_s', 0.0):.3f}",
+                f"{dev.get('util', 0.0):.3f}",
+            ]
         )
     if len(rows) == 1:
         return "<no completed eras in trace ring>"

@@ -294,6 +294,48 @@ def test_compare_wrapper_and_schema_errors(tmp_path):
     assert compare.main([str(b), str(e)]) == 2
 
 
+def _mesh_result(util=0.95, era_s=6.0, devices=8, value=6.0):
+    return {
+        "metric": "consensus_sim_era_latency_s",
+        "value": value,
+        "trial_spread_pct": 5.0,
+        "mesh_devices": devices,
+        "mesh_pad_waste_fraction": 0.0,
+        "mesh_device_util_floor": util,
+        "era_phase_report_s": {
+            "1": {"wall_s": era_s, "idle_s": 0.0, "overlap_s": 0.0},
+            "2": {"wall_s": era_s, "idle_s": 0.0, "overlap_s": 0.0},
+        },
+    }
+
+
+def test_compare_mesh_self_gate(tmp_path):
+    """MULTICHIP gate contract: a mesh baseline passes against itself; a
+    device-utilization collapse or per-era wall regression gates (exit 1);
+    a mesh-width mismatch is a schema error (exit 2), never a silent pass."""
+    base = _mesh_result()
+    args = ("--min-threshold-pct", "60")
+    assert _gate(tmp_path, base, _mesh_result(), *args) == 0
+    assert _gate(tmp_path, base, _mesh_result(era_s=20.0, value=20.0), *args) == 1
+    assert _gate(tmp_path, base, _mesh_result(util=0.2), *args) == 1
+    assert _gate(tmp_path, base, _mesh_result(devices=4), *args) == 2
+
+
+def test_compare_checked_in_multichip_baseline():
+    """The checked-in bench-gate mesh baseline must pass against itself —
+    guards the Makefile bench-gate mesh leg from schema drift."""
+    import os
+
+    import benchmarks.compare as compare
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        "MULTICHIP_sim_gate.json",
+    )
+    assert compare.main([path, path, "--min-threshold-pct", "60"]) == 0
+
+
 def test_rpc_and_cli_era_report_surface():
     """la_getEraReport returns the merged report shape, and the trace CLI
     accepts --era-report (the devnet runbook path)."""

@@ -30,6 +30,12 @@ from lachain_tpu.parallel.mesh import (
     sharded_glv_era_step,
 )
 
+# slice marker: multi-device mesh crypto ("make test-mesh" / the CI mesh
+# job). Kernel-compiling tests are additionally marked slow so the tier-1
+# 'not slow' sweep never pays shard_map compiles; the mesh job runs -m mesh
+# INCLUDING slow, so they can never silently skip everywhere.
+pytestmark = pytest.mark.mesh
+
 
 def _rand_points(rng, n):
     return [bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R)) for _ in range(n)]
@@ -42,6 +48,7 @@ def _oracle_msm(points, scalars):
     return acc
 
 
+@pytest.mark.slow
 def test_sharded_era_step_matches_single_device():
     """Bit-equality: the shard_mapped era kernel on the 8-device mesh equals
     the same kernel run unsharded on one device."""
@@ -97,6 +104,7 @@ def test_sharded_era_step_matches_single_device():
             assert bls.g1_eq(pa, pb)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("s,k", [(3, 5), (1, 9), (6, 22)])
 def test_mesh_pipeline_nonpow2_padding(s, k):
     """MeshEraPipeline pads non-pow2 share counts and non-mesh-multiple slot
@@ -122,6 +130,7 @@ def test_mesh_pipeline_nonpow2_padding(s, k):
         assert bls.g1_eq(comb, _oracle_msm(us, lag))
 
 
+@pytest.mark.slow
 def test_mesh_pipeline_masked_absent_lanes():
     """Uneven slots: masked (absent-share) lanes contribute to neither
     aggregate — parity with the oracle over the live lanes only."""
@@ -150,6 +159,7 @@ def test_mesh_pipeline_masked_absent_lanes():
     assert bls.g1_eq(comb, _oracle_msm([us[i] for i in live], [lag[i] for i in live]))
 
 
+@pytest.mark.slow
 def test_tpu_backend_selects_mesh_and_verifies():
     """End-to-end: with >1 device visible the TPU backend routes
     tpke_era_verify_combine through the mesh pipeline, and the results match
@@ -192,3 +202,108 @@ def test_tpu_backend_selects_mesh_and_verifies():
     res = backend.tpke_era_verify_combine(jobs, kg.verification_keys)
     assert res[0][0] and res[1][0] and not res[2][0]
     assert backend.era_calls == 1
+
+
+def test_mesh_padding_and_staging_unit():
+    """Host-only invariants (no kernel compiles, runs in tier-1): padded
+    shape math, staging-buffer re-clean after a shrinking live region, and
+    the Lagrange digit-plane cache."""
+    pipe = MeshEraPipeline(n_devices=8)
+    assert pipe.mesh.shape["slot"] == 4 and pipe.mesh.shape["share"] == 2
+    assert pipe.padded_shape(3, 5) == (4, 8)
+    assert pipe.padded_shape(1, 9) == (4, 16)
+    assert pipe.padded_shape(4, 4) == (4, 4)
+    assert pipe.padded_shape(5, 4) == (8, 4)
+
+    st = pipe._get_staging(4, 8)
+    st.clean(4, 8)
+    st.u[:] = 1
+    st.rlc[:] = 7
+    st._filled = (4, 8)
+    st.clean(2, 2)  # stale tail from the (4,8) fill must be re-cleaned
+    inf = np.broadcast_to(pipe._inf_row, (2, 8) + pipe._inf_row.shape)
+    assert np.array_equal(st.u[2:, :8], inf)
+    assert not st.rlc[2:, :8].any() and not st.rlc[:2, 2:8].any()
+    assert st.rlc[:2, :2].all()  # live region untouched
+
+    row = (123, 456, 789)
+    planes = pipe._lag_cache.get(row)
+    assert pipe._lag_cache.get(list(row)) is planes
+
+
+# -- satellite: randomized mesh-vs-single-device differential -----------------
+# One Glv (single-device oracle) run per N, reused across the three mesh
+# shapes; both pipelines derive RLC coefficients through the shared era_rlc,
+# so an identically seeded rng must yield identical coefficient rows and
+# (by g1_eq, i.e. affine identity) identical per-slot aggregates.
+
+_DIFF_CASES: dict = {}
+
+
+def _diff_fixture(n):
+    cached = _DIFF_CASES.get(n)
+    if cached is not None:
+        return cached
+    from lachain_tpu.ops.verify import GlvEraPipeline
+
+    rng = random.Random(9000 + n)
+    k, s = n, 3  # s=3 divides none of the slot axes (1x1 aside): real padding
+    y_points = _rand_points(rng, k)
+    slots, masks = [], []
+    for si in range(s):
+        mask = [True] * k
+        if si == 1:  # absent shares on the middle slot
+            mask[0] = False
+            mask[k - 1] = False
+        lag = [rng.randrange(1, bls.R) if m else 0 for m in mask]
+        us = [
+            p if m else bls.G1_INF
+            for p, m in zip(_rand_points(rng, k), mask)
+        ]
+        slots.append((us, lag))
+        masks.append(mask)
+
+    glv_rng = random.Random(31337 + n)
+
+    class R:
+        def randbelow(self, m):
+            return glv_rng.randrange(m)
+
+    out, rlc = GlvEraPipeline().run_era(slots, y_points, R(), masks=masks)
+    _DIFF_CASES[n] = (slots, y_points, masks, out, rlc)
+    return _DIFF_CASES[n]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", [1, 2, 8])  # meshes 1x1, 2x1, 4x2
+@pytest.mark.parametrize("n", [4, 7, 16])
+def test_mesh_vs_glv_differential(n, n_devices):
+    """MeshEraPipeline.run_era must be point-identical (g1_eq — affine
+    identity; Jacobian Z may differ) to the single-device GlvEraPipeline
+    for the same inputs and rng seed, including masked lanes and slot
+    counts that do not divide the mesh's slot axis."""
+    from lachain_tpu.utils import metrics
+
+    slots, y_points, masks, exp_out, exp_rlc = _diff_fixture(n)
+    mesh_rng = random.Random(31337 + n)
+
+    class R:
+        def randbelow(self, m):
+            return mesh_rng.randrange(m)
+
+    pipe = MeshEraPipeline(n_devices=n_devices)
+    assert pipe.n_devices == n_devices
+    out, rlc = pipe.run_era(slots, y_points, R(), masks=masks)
+
+    assert [list(r) for r in rlc] == [list(r) for r in exp_rlc]
+    assert len(out) == len(exp_out)
+    for (ua, ya, ca), (ub, yb, cb) in zip(out, exp_out):
+        assert bls.g1_eq(ua, ub)
+        assert bls.g1_eq(ya, yb)
+        assert bls.g1_eq(ca, cb)
+
+    # satellite gauges: published on every dispatch, once-per-shape logged
+    s_pad, k_pad = pipe.padded_shape(len(slots), len(y_points))
+    waste = 1.0 - (len(slots) * len(y_points)) / (s_pad * k_pad)
+    assert metrics.gauge_value("mesh_devices") == n_devices
+    assert abs(metrics.gauge_value("mesh_pad_waste_fraction") - waste) < 1e-9

@@ -387,3 +387,86 @@ def test_rs_decode_mixed_size_shards_rejected():
 
     assert native_decode(shards_bad) is None
     assert native_decode([shards[0], shards[1], None, None]) == payload
+
+
+def test_rs_replication_mode_past_gf256():
+    """GF(2^8) has only 255 evaluation points, so n > 255 degrades to
+    whole-payload replication on BOTH engines (every shard = the full
+    length-prefixed payload). This is what lets RBC run at N=512: the
+    old eval-point arithmetic wrapped `uint8_t(idx+1)` past 255 and
+    indexed GF_MUL out of bounds. Thresholds and Merkle commitments are
+    unchanged; only the shard contents differ from the coded regime."""
+    import ctypes
+
+    from lachain_tpu.consensus.native_rt import load_rt
+    from lachain_tpu.ops import rs
+
+    payload = b"replication-mode past the GF(2^8) point budget" * 7
+    n, k = 300, 100
+    shards = rs.encode(payload, k, n)
+    assert len(shards) == n
+    # replication: every shard is the identical prefixed payload
+    assert len(set(shards)) == 1
+    assert shards[0] == len(payload).to_bytes(4, "big") + payload
+
+    # decode from a sparse subset with exactly k present
+    sparse: list = [None] * n
+    for i in range(0, 3 * k, 3):
+        sparse[i] = shards[i]
+    assert rs.decode(sparse, k) == payload
+    # the k-present threshold still applies (protocol parity with the
+    # coded regime, even though one replica would suffice)
+    assert rs.decode([shards[0]] + [None] * (n - 1), k) is None
+    # mixed-size shards stay a clean failure
+    bad = list(shards)
+    bad[0] = shards[0] + b"\x00"
+    assert rs.decode(bad, k) is None
+    # truncated length prefix -> clean failure
+    assert rs.decode([b"\x00\x00" for _ in range(n)], k) is None
+    # reencode reconstructs the full replica set for the Merkle recheck
+    assert rs.reencode(sparse, k) == shards
+
+    # native engine: same replication decode through the test hook
+    lib = load_rt()
+    lib.rt_test_rs_decode.restype = ctypes.c_int
+    arr_t = ctypes.POINTER(ctypes.c_ubyte) * n
+    len_t = ctypes.c_size_t * n
+
+    def native_decode(sh):
+        bufs = [
+            (ctypes.c_ubyte * len(s)).from_buffer_copy(s) if s else None
+            for s in sh
+        ]
+        ptrs = arr_t(*[
+            ctypes.cast(b, ctypes.POINTER(ctypes.c_ubyte))
+            if b is not None
+            else ctypes.POINTER(ctypes.c_ubyte)()
+            for b in bufs
+        ])
+        lens = len_t(*[len(s) if s else 0 for s in sh])
+        cap = 2 * max((len(s) for s in sh if s), default=1) + 64
+        out = (ctypes.c_ubyte * cap)()
+        out_len = ctypes.c_size_t(0)
+        ok = lib.rt_test_rs_decode(
+            ptrs, lens, n, k, out, ctypes.byref(out_len)
+        )
+        return bytes(out[: out_len.value]) if ok else None
+
+    assert native_decode(sparse) == payload
+    assert native_decode(bad) is None
+    assert native_decode([shards[0]] + [None] * (n - 1)) is None
+
+
+def test_rt_new_rejects_past_512():
+    """rt_new's membership masks are 512-bit; N=513 must be a clean
+    nullptr (surfaced as ValueError by the binding), not silent
+    out-of-bounds bit writes — the pre-fix 256-bit masks GPF'd inside
+    RBC::try_deliver at N=512."""
+    from lachain_tpu.consensus.native_rt import load_rt
+
+    lib = load_rt()
+    assert not lib.rt_new(513, 170, 0, 0, 0, 0)
+    assert not lib.rt_new(0, 0, 0, 0, 0, 0)
+    h = lib.rt_new(512, 170, 0, 0, 0, 0)
+    assert h, "N=512 must construct — it is the supported ceiling"
+    lib.rt_free(h)
