@@ -28,6 +28,24 @@ class Rng:
         return self._r.randrange(n)
 
 
+def _hist_quantile(snap, q: float):
+    """Linear interpolation inside the bucket holding the q-quantile of a
+    metrics.histogram_snapshot() — the standard Prometheus histogram_quantile
+    estimate, computed locally so the bench emits a plain number."""
+    if not snap or not snap["count"] or not snap["buckets"]:
+        return None
+    target = q * snap["count"]
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in snap["buckets"]:
+        if cum >= target:
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    # q falls in the +Inf overflow bucket: clamp to the last finite bound
+    return snap["buckets"][-1][0]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=64)
@@ -98,7 +116,11 @@ def main() -> None:
     from lachain_tpu.core.devnet import Devnet
     from lachain_tpu.core.types import Transaction, sign_transaction
     from lachain_tpu.crypto import ecdsa
-    from lachain_tpu.utils import metrics, tracing
+    from lachain_tpu.utils import metrics, tracing, txtrace
+
+    # densify tx lifecycle sampling (1-in-4) so the e2e percentiles rest
+    # on a meaningful sample even at the small bench-gate leg (--txs 64)
+    txtrace.set_sample_shift(2)
 
     if args.mesh_devices > 0:
         # precompile the mesh-shaped era kernels off the clock (one entry
@@ -239,6 +261,12 @@ def main() -> None:
     # once — (n-1)/n of the measured block_execute time is sim-only
     # redundancy. The normalized number subtracts that share from the era
     # wall time; the raw number stays reported next to it.
+    # tx lifecycle e2e percentiles from the txtrace histogram (submit ->
+    # commit of sampled txs), interpolated the histogram_quantile way
+    e2e_snap = metrics.histogram_snapshot("tx_e2e_seconds")
+    tx_p50 = _hist_quantile(e2e_snap, 0.50)
+    tx_p99 = _hist_quantile(e2e_snap, 0.99)
+
     best = min(range(len(times)), key=lambda i: times[i])
     era_s = times[best]
     redundant_s = exec_times[best] * (n - 1) / n
@@ -278,6 +306,16 @@ def main() -> None:
                 "mesh_device_util_floor": round(min(mesh_utils), 4)
                 if mesh_utils
                 else None,
+                # tx submit->commit latency of the 1-in-4 sampled txs
+                # (utils/txtrace stamps; gate fields in compare.py
+                # LATENCY_FIELDS, compared when both runs report them)
+                "tx_e2e_p50_s": round(tx_p50, 4)
+                if tx_p50 is not None
+                else None,
+                "tx_e2e_p99_s": round(tx_p99, 4)
+                if tx_p99 is not None
+                else None,
+                "tx_e2e_sampled": e2e_snap["count"] if e2e_snap else 0,
                 # flight recorder: where inside each timed era the time went
                 "era_phase_report_s": phase_report,
                 # ON-vs-OFF min-era delta when --overhead-check ran

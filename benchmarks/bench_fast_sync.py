@@ -95,12 +95,12 @@ async def run(args) -> dict:
         obs.connect(addrs)
         for s in servers:
             s.connect([obs.address])
-        base = counter("fastsync_nodes_downloaded")
+        base = counter("fastsync_nodes_downloaded_total")
         t0 = time.perf_counter()
         synced = await obs.fast_sync.sync(peers, timeout=args.timeout)
         dt = time.perf_counter() - t0
         assert synced == 1
-        nodes_total = int(counter("fastsync_nodes_downloaded") - base)
+        nodes_total = int(counter("fastsync_nodes_downloaded_total") - base)
         rates.append(nodes_total / dt)
         await obs.stop()
     best = max(rates)
@@ -113,10 +113,10 @@ async def run(args) -> dict:
         s.connect([obs.address])
     fs = obs.fast_sync
     fs.request_timeout = 1.0
-    base_nodes = counter("fastsync_nodes_downloaded")
+    base_nodes = counter("fastsync_nodes_downloaded_total")
     base_fail = counter("fastsync_failovers_total")
     task = asyncio.create_task(fs.sync(peers, timeout=args.timeout))
-    while counter("fastsync_nodes_downloaded") - base_nodes < nodes_total // 10:
+    while counter("fastsync_nodes_downloaded_total") - base_nodes < nodes_total // 10:
         await asyncio.sleep(0.002)
     ks = KillSwitch(servers[0].network.hub.frame_filter)
     servers[0].network.hub.frame_filter = ks
@@ -126,8 +126,8 @@ async def run(args) -> dict:
     # a node past that point before we call the download "recovered"
     while counter("fastsync_failovers_total") <= base_fail:
         await asyncio.sleep(0.002)
-    v0 = counter("fastsync_nodes_downloaded")
-    while counter("fastsync_nodes_downloaded") <= v0:
+    v0 = counter("fastsync_nodes_downloaded_total")
+    while counter("fastsync_nodes_downloaded_total") <= v0:
         await asyncio.sleep(0.002)
     recovery = time.perf_counter() - t_kill
     synced = await task

@@ -42,6 +42,12 @@ LATENCY_FIELDS = (
     "merkle_hash_s",
     "merkle_assemble_s",
     "wal_fsync_s",
+    # tx lifecycle e2e percentiles (PR 15, bench_consensus_sim via
+    # utils/txtrace stamps): submit -> commit wall time of sampled txs,
+    # interpolated from the tx_e2e_seconds histogram. Only compared when
+    # both runs report them, so pre-15 baselines stay valid.
+    "tx_e2e_p50_s",
+    "tx_e2e_p99_s",
 )
 
 # throughput-shaped side fields compared higher-is-better when both runs

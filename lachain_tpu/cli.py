@@ -176,6 +176,12 @@ def _build_node(cfg, config_path=None):
 
         tracing.DEFAULT_CAPACITY = max(int(cfg.trace_capacity), 0)
         tracing.set_capacity(max(tracing.DEFAULT_CAPACITY, 1))
+    if cfg.tx_sample_shift is not None:
+        # tx lifecycle sampling density: 1-in-2^shift transactions carry
+        # stage stamps (observability.txSampleShift; 0 = stamp every tx)
+        from .utils import txtrace
+
+        txtrace.set_sample_shift(int(cfg.tx_sample_shift))
     password = cfg.vault.password or os.environ.get(
         "LACHAIN_WALLET_PASSWORD", ""
     )
@@ -497,6 +503,60 @@ def cmd_trace(args) -> int:
         )
     else:
         print(text)
+    return 0
+
+
+def cmd_fleet_trace(args) -> int:
+    """Scrape N nodes' traces/era reports/health over RPC, align their
+    clocks by RTT-bracketed la_time pings, and write ONE merged Chrome
+    trace with a pid lane block per node. Searching the merged trace for
+    a sampled tx's 16-hex-char trace id (la_getTxTrace -> traceId) lights
+    up its lifecycle across every node that touched it."""
+    from .utils import fleetview
+
+    names = args.names.split(",") if args.names else None
+    if names is not None and len(names) != len(args.rpc):
+        print("error: --names count must match --rpc count", file=sys.stderr)
+        return 1
+    merged, report = fleetview.collect(
+        args.rpc,
+        names=names,
+        samples=args.samples,
+        timeout=args.timeout,
+        api_key=args.api_key,
+    )
+    unreachable = [
+        n["name"]
+        for n in merged["fleet"]["nodes"]
+        if n["errors"].get("trace") and n["errors"].get("eraReport")
+    ]
+    if unreachable:
+        print(
+            f"warning: no data from {', '.join(unreachable)}",
+            file=sys.stderr,
+        )
+        if len(unreachable) == len(args.rpc):
+            print("error: every node unreachable", file=sys.stderr)
+            return 1
+    print(fleetview.fleet_era_table(report))
+    for n in merged["fleet"]["nodes"]:
+        status = n["status"] or "?"
+        unc = n["uncertaintyUs"]
+        print(
+            f"{n['name']}: status={status} "
+            f"offset={n['offsetUs'] or 0:.0f}us"
+            + (f" (±{unc:.0f}us)" if unc is not None else "")
+        )
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(json.dumps(merged))
+        n_events = sum(
+            1 for e in merged["traceEvents"] if e.get("ph") != "M"
+        )
+        print(
+            f"{n_events} events from {len(args.rpc)} nodes -> {args.out} "
+            "(open in chrome://tracing or https://ui.perfetto.dev)"
+        )
     return 0
 
 
@@ -1008,6 +1068,33 @@ def main(argv=None) -> int:
         "commit + idle) from the merged flight recorder",
     )
     tr.set_defaults(fn=cmd_trace)
+
+    ft = sub.add_parser(
+        "fleet-trace",
+        help="merge N nodes' traces into one clock-aligned Chrome trace "
+        "with per-node lanes, plus the fleet era/skew table",
+    )
+    ft.add_argument(
+        "--rpc",
+        nargs="+",
+        required=True,
+        help="one RPC URL per node, e.g. http://10.0.0.1:7070",
+    )
+    ft.add_argument(
+        "--names",
+        help="comma-separated node labels matching --rpc order "
+        "(default node0..nodeN-1)",
+    )
+    ft.add_argument("--timeout", type=float, default=10.0)
+    ft.add_argument(
+        "--samples",
+        type=int,
+        default=5,
+        help="la_time pings per node for clock alignment",
+    )
+    ft.add_argument("--api-key", help="x-api-key if the RPC is gated")
+    ft.add_argument("--out", help="write the merged trace JSON here")
+    ft.set_defaults(fn=cmd_fleet_trace)
 
     de = sub.add_parser("decrypt", help="print a wallet's decrypted JSON")
     de.add_argument("--wallet", required=True)

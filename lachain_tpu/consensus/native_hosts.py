@@ -438,6 +438,13 @@ class RootHost:
                     seen.add(h)
                     txs.append(stx)
         self._txs = txs
+        # tx lifecycle decide stamp — same point as the Python oracle's
+        # _try_sign_header union (sampled-only, first stamp wins)
+        from ..utils import txtrace
+
+        txtrace.stamp_many(
+            (stx.hash() for stx in txs), "decide", era=self.id.era
+        )
         self._header = self._producer.create_header(self.id.era, txs, nonce)
         self._header_hash = self._header.hash()
         sig = ecdsa.sign_hash(self._priv, self._header_hash)

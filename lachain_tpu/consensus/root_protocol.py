@@ -89,6 +89,13 @@ class RootProtocol(Protocol):
                     seen.add(h)
                     txs.append(stx)
         self._txs = txs
+        # tx lifecycle: consensus agreed on the era's tx union (the decide
+        # point — every honest node derives the same set here)
+        from ..utils import txtrace
+
+        txtrace.stamp_many(
+            (stx.hash() for stx in txs), "decide", era=self.id.era
+        )
         self._header = self._producer.create_header(
             self.id.era, txs, self._nonce
         )

@@ -24,6 +24,7 @@ from ..storage.state import StateManager, StateRoots
 from ..utils import metrics
 from ..utils import bloom
 from ..utils import tracing
+from ..utils import txtrace
 from ..utils.serialization import write_u32, write_u64
 from .execution import TransactionExecuter, set_balance
 from .parallel_exec import (
@@ -180,6 +181,12 @@ class BlockManager:
             # ordering + execution then hit warm caches only
             warm_sender_caches(txs, self.executer.chain_id)
             txs = self.order_transactions(txs, self.executer.chain_id)
+            # tx lifecycle: execution reached this block (stamped before
+            # emulate so a memo hit — block already emulated during header
+            # creation — still marks when THIS node's execute touched it)
+            txtrace.stamp_many(
+                (stx.hash() for stx in txs), "exec", era=header.index
+            )
             em = self.emulate(txs, header.index)
             if check_state_hash and em.state_hash != header.state_hash:
                 raise ValueError(
@@ -260,6 +267,11 @@ class BlockManager:
         crash_point("block.persist.mid")
         self.state.commit(block.header.index, em.roots)
         crash_point("block.persist.post")
+        # tx lifecycle terminal stamp: the block holding the tx is durable
+        # (also closes tx_e2e_seconds for sampled txs)
+        txtrace.stamp_many(
+            block.tx_hashes, "commit", era=block.header.index
+        )
         for cb in list(self.on_block_persisted):
             cb(block)
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import random
 from typing import List, Optional, Sequence
 
+from ..utils import txtrace
 from ..utils.serialization import Reader, write_bytes_list
 from .block_manager import BlockManager
 from .tx_pool import TransactionPool
@@ -113,13 +114,19 @@ class BlockProducer:
             if self.proposal_seed >= 0
             else None
         )
-        return self.pool.peek(
+        txs = self.pool.peek(
             max(self.txs_per_block // max(self.n, 1), 1),
             rng=rng,
             window_txs=2 * self.txs_per_block,
             exclude=self._ov_exclude if self._ov_exclude else None,
             nonce_override=self._ov_nonces if self._ov_nonces else None,
         )
+        # tx lifecycle: these txs ride OUR proposal for era height+1
+        # (sampled-only; first stamp wins across repeated proposals)
+        txtrace.stamp_many(
+            (stx.hash() for stx in txs), "propose", era=height + 1
+        )
+        return txs
 
     # -- header -----------------------------------------------------------------
     def create_header(

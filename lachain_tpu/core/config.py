@@ -276,6 +276,17 @@ class NodeConfig:
         cap = self.raw.get("observability", {}).get("traceCapacity")
         return None if cap is None else int(cap)
 
+    @property
+    def tx_sample_shift(self) -> Optional[int]:
+        """Tx-lifecycle sampling (utils/txtrace.py): keep 1/2^shift of
+        transactions (0 = stamp every tx). Optional and additive (no
+        config version bump): absent keeps the built-in default. The
+        sampling decision itself is a deterministic function of the tx
+        hash, but the SHIFT must match fleet-wide for cross-node timelines
+        to align (DEPLOY.md "Fleet observability")."""
+        shift = self.raw.get("observability", {}).get("txSampleShift")
+        return None if shift is None else int(shift)
+
     @classmethod
     def from_dict(cls, cfg: dict) -> "NodeConfig":
         cfg = migrate(cfg)

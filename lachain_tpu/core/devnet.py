@@ -203,6 +203,9 @@ class Devnet:
         """Reference path: eth_sendRawTransaction -> TransactionPool.Add; the
         devnet gossips the tx to every node's pool (BroadcastLocalTransaction
         role)."""
+        from ..utils import txtrace
+
+        txtrace.stamp(stx.hash(), "submit")
         ok = self.nodes[to_node].pool.add(stx)
         if ok:
             for node in self.nodes:

@@ -751,7 +751,7 @@ class FastSynchronizer:
                 kv.write_batch(puts)
                 stored += len(puts)
                 # progress counter served by la_getDownloadedNodesTillNow
-                metrics.inc("fastsync_nodes_downloaded", len(puts))
+                metrics.inc("fastsync_nodes_downloaded_total", len(puts))
             if got:
                 s.served += len(got)
                 metrics.inc(

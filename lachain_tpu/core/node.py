@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional
 
 from ..consensus import messages as M
@@ -155,6 +156,13 @@ class Node:
         self._replay_served_at: Dict[tuple, float] = {}
         # native-engine stall detector state: (last_state_string, since, strikes)
         self._native_watch: tuple = ("", 0.0, 0)
+        # health/SLO surface: last-commit clocks (monotonic for age math,
+        # wall for display) seeded at boot so tip age counts from startup,
+        # plus the highest watchdog escalation stage seen since the last
+        # persisted block — forward progress clears the strike memory
+        self._last_commit_mono = time.monotonic()
+        self._last_commit_wall = time.time()
+        self._stall_stage = 0
         self.validator_manager = ValidatorManager(self.state, public_keys)
         from .fast_sync import FastSynchronizer
 
@@ -436,6 +444,7 @@ class Node:
         OUR message). Stage 3+: also force the transport to reconnect."""
         from ..utils import metrics
 
+        self._stall_stage = max(self._stall_stage, stage)
         if stage < 2:
             return
         metrics.inc(
@@ -463,6 +472,52 @@ class Node:
         if stage >= 3:
             self.network.reconnect_peers()
 
+    def health(self) -> Dict[str, object]:
+        """One-glance health verdict served by `GET /healthz` and
+        `la_getHealth`. Three-state so load balancers and fleet dashboards
+        can act without parsing the detail fields:
+
+        ok       — committing, peered, no watchdog strikes
+        degraded — behind the fleet's median height, peerless, tip older
+                   than stall_timeout, or one stall strike
+        stalled  — watchdog escalated (strike >= 2, python or native) or
+                   no commit for 2x stall_timeout
+        """
+        now = time.monotonic()
+        tip_age = now - self._last_commit_mono
+        height = self.block_manager.current_height()
+        peer_heights = sorted(self.synchronizer.peer_heights.values())
+        median_peer = (
+            peer_heights[len(peer_heights) // 2] if peer_heights else height
+        )
+        lag = max(0, median_peer - height)
+        strikes = max(self._stall_stage, self._native_watch[2])
+        # peerless is only a symptom when peers are EXPECTED: a
+        # single-validator devnet with nobody to dial stays "ok"
+        expected_peers = max(0, len(self._pub_by_index) - 1)
+        verdict = "ok"
+        if (
+            lag > 5
+            or tip_age > self.stall_timeout
+            or (expected_peers > 0 and not self.network.peers)
+            or strikes == 1
+        ):
+            verdict = "degraded"
+        if strikes >= 2 or tip_age > 2 * self.stall_timeout:
+            verdict = "stalled"
+        return {
+            "status": verdict,
+            "height": height,
+            "era": self.router.era if self.router is not None else None,
+            "tipAgeSeconds": round(tip_age, 3),
+            "lastCommitUnix": round(self._last_commit_wall, 3),
+            "peerCount": len(self.network.peers),
+            "poolDepth": len(self.pool),
+            "medianPeerHeight": median_peer,
+            "commitLagVsPeers": lag,
+            "stallStrikes": strikes,
+        }
+
     async def start_rpc(
         self,
         host: str = "127.0.0.1",
@@ -481,6 +536,9 @@ class Node:
             host, port, api_key=api_key, auth_pubkey=auth_pubkey
         )
         server.register_all(RpcService(self).methods())
+        # liveness probes must work without credentials: the server special-
+        # cases GET /healthz through this hook before its api-key gate
+        server.health_fn = self.health
         await server.start()
         self._rpc_server = server
         return server
@@ -524,6 +582,11 @@ class Node:
     # -- tx ingress + gossip -----------------------------------------------
 
     def submit_tx(self, stx: SignedTransaction) -> bool:
+        # tx lifecycle origin stamp: ingress accepted BEFORE pool admission
+        # so the submit→pool delta measures admission, not transport
+        from ..utils import txtrace
+
+        txtrace.stamp(stx.hash(), "submit")
         ok = self.pool.add(stx)
         if ok:
             self.network.broadcast(wire.sync_pool_reply([stx]))
@@ -769,7 +832,16 @@ class Node:
             outcome = "consensus"
             return block
         finally:
-            tracing.end(sid, outcome=outcome)
+            # cross-node causality: our era span carries OUR deterministic
+            # trace id (what peers saw on our wire trailers) plus every
+            # peer id observed inbound this era — the fleet merger joins
+            # spans across pid lanes on exactly these ids
+            tracing.end(
+                sid,
+                outcome=outcome,
+                trace=wire.era_trace_id(self.network.public_key, era).hex(),
+                peer_traces=",".join(self.network.trace_ids_for(era)),
+            )
 
     async def run_eras(self, first: int, count: int) -> List[Block]:
         return [await self.run_era(first + i) for i in range(count)]
@@ -813,6 +885,11 @@ class Node:
         tracing.instant(
             "block_persisted", cat="block", height=block.header.index
         )
+        # a persisted block is the strongest health signal: refresh the
+        # tip-age clocks and forgive past watchdog strikes
+        self._last_commit_mono = time.monotonic()
+        self._last_commit_wall = time.time()
+        self._stall_stage = 0
         snap = self.state.new_snapshot()
         self.validator_status.on_block_persisted(block, snap)
         self.keygen_manager.on_block_persisted(block, snap)

@@ -29,7 +29,7 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..storage.kv import EntryPrefix, KVStore, prefixed
-from ..utils import metrics
+from ..utils import metrics, txtrace
 from .types import SignedTransaction
 
 _N_SHARDS = 16
@@ -132,7 +132,10 @@ class TransactionPool:
 
             crash_point("pool.save.mid")
             self._kv.put(prefixed(EntryPrefix.POOL_TX, h), stx.encode())
-            return True
+        # tx lifecycle stamp OUTSIDE the shard lock (admission succeeded;
+        # sampled-only, first stamp wins across gossip re-admissions)
+        txtrace.stamp(h, "pool")
+        return True
 
     # -- proposal --------------------------------------------------------------
     def next_nonce(self, sender: bytes) -> int:

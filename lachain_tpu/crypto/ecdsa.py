@@ -346,10 +346,10 @@ def _tpu_recover(hashes, sigs):
         return None
     try:
         out = rec.recover_batch(list(hashes), list(sigs))
-        metrics.inc("crypto_tpu_ecdsa_recover_batches")
+        metrics.inc("crypto_tpu_ecdsa_recover_batches_total")
         return out
     except Exception:
-        metrics.inc("crypto_tpu_ecdsa_recover_fallbacks")
+        metrics.inc("crypto_tpu_ecdsa_recover_fallbacks_total")
         return None
 
 

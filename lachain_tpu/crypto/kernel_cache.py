@@ -150,7 +150,7 @@ def call(jit_fn, name: str, *args, **statics):
     `args` must all be arrays (shapes form the cache key); `statics` are
     the jit's static kwargs."""
     if not _single_device():
-        metrics.inc("kernel_cache_requests", labels={"tier": "bypass"})
+        metrics.inc("kernel_cache_requests_total", labels={"tier": "bypass"})
         return jit_fn(*args, **statics)
     key = _key(name, args, statics)
     compiled = _memo.get(key)
@@ -161,7 +161,7 @@ def call(jit_fn, name: str, *args, **statics):
                 compiled = _disk_load(key)
                 if compiled is None:
                     metrics.inc(
-                        "kernel_cache_requests", labels={"tier": "compile"}
+                        "kernel_cache_requests_total", labels={"tier": "compile"}
                     )
                     t0 = metrics.monotonic()
                     lowered = jit_fn.lower(*args, **statics)
@@ -174,16 +174,16 @@ def call(jit_fn, name: str, *args, **statics):
                     _disk_store(key, compiled)
                 else:
                     metrics.inc(
-                        "kernel_cache_requests", labels={"tier": "disk"}
+                        "kernel_cache_requests_total", labels={"tier": "disk"}
                     )
                 with _lock:
                     _memo[key] = compiled
             else:
                 metrics.inc(
-                    "kernel_cache_requests", labels={"tier": "memo"}
+                    "kernel_cache_requests_total", labels={"tier": "memo"}
                 )
     else:
-        metrics.inc("kernel_cache_requests", labels={"tier": "memo"})
+        metrics.inc("kernel_cache_requests_total", labels={"tier": "memo"})
     return compiled(*args)
 
 
@@ -220,13 +220,13 @@ def call_mesh(jit_fn, name: str, mesh, *args):
                         compiled = None
                     else:
                         metrics.inc(
-                            "kernel_cache_requests", labels={"tier": "disk"}
+                            "kernel_cache_requests_total", labels={"tier": "disk"}
                         )
                         with _lock:
                             _memo[key] = compiled
                         return out
                 metrics.inc(
-                    "kernel_cache_requests", labels={"tier": "compile"}
+                    "kernel_cache_requests_total", labels={"tier": "compile"}
                 )
                 t0 = metrics.monotonic()
                 compiled = jit_fn.lower(*args).compile()
@@ -239,7 +239,7 @@ def call_mesh(jit_fn, name: str, mesh, *args):
                 with _lock:
                     _memo[key] = compiled
                 return compiled(*args)
-    metrics.inc("kernel_cache_requests", labels={"tier": "memo"})
+    metrics.inc("kernel_cache_requests_total", labels={"tier": "memo"})
     try:
         return compiled(*args)
     except Exception:
@@ -256,24 +256,24 @@ def warm(jit_fn, name: str, *args, **statics) -> bool:
     WITHOUT running it. Returns True if it came from disk."""
     if not _single_device():
         jit_fn.lower(*args, **statics).compile()  # jax's in-process cache
-        metrics.inc("kernel_cache_warm", labels={"tier": "bypass"})
+        metrics.inc("kernel_cache_warm_total", labels={"tier": "bypass"})
         return False
     key = _key(name, args, statics)
     if key in _memo:
-        metrics.inc("kernel_cache_warm", labels={"tier": "memo"})
+        metrics.inc("kernel_cache_warm_total", labels={"tier": "memo"})
         return True
     with _lock_for(key):
         if key in _memo:
-            metrics.inc("kernel_cache_warm", labels={"tier": "memo"})
+            metrics.inc("kernel_cache_warm_total", labels={"tier": "memo"})
             return True
         compiled = _disk_load(key)
         from_disk = compiled is not None
         if compiled is None:
-            metrics.inc("kernel_cache_warm", labels={"tier": "compile"})
+            metrics.inc("kernel_cache_warm_total", labels={"tier": "compile"})
             compiled = jit_fn.lower(*args, **statics).compile()
             _disk_store(key, compiled)
         else:
-            metrics.inc("kernel_cache_warm", labels={"tier": "disk"})
+            metrics.inc("kernel_cache_warm_total", labels={"tier": "disk"})
         with _lock:
             _memo[key] = compiled
     return from_disk

@@ -214,7 +214,7 @@ class TpuBackend:
         try:
             return self._device_msm(points, scalars, g2=False)
         except Exception:
-            metrics.inc("crypto_tpu_msm_fallbacks")
+            metrics.inc("crypto_tpu_msm_fallbacks_total")
             return self._host.g1_msm(points, scalars)
 
     def g2_msm(self, points, scalars):
@@ -225,7 +225,7 @@ class TpuBackend:
         try:
             return self._device_msm(points, scalars, g2=True)
         except Exception:
-            metrics.inc("crypto_tpu_msm_fallbacks")
+            metrics.inc("crypto_tpu_msm_fallbacks_total")
             return self._host.g2_msm(points, scalars)
 
     def _device_msm(self, points, scalars, g2: bool):
@@ -257,7 +257,7 @@ class TpuBackend:
                 )
             )
             out = pg1.g1_unpack(fused[:132], fused[132] != 0)
-        metrics.inc("crypto_tpu_device_msm_calls")
+        metrics.inc("crypto_tpu_device_msm_calls_total")
         metrics.observe_hist(
             "crypto_tpu_device_msm_seconds",
             metrics.monotonic() - t0,
@@ -334,7 +334,7 @@ class TpuBackend:
         )
         self.era_calls += 1
         self.era_slots_total += len(jobs)
-        metrics.inc("crypto_tpu_era_kernel_calls")
+        metrics.inc("crypto_tpu_era_kernel_calls_total")
         return results
 
     def tpke_era_verify_combine_async(
@@ -377,7 +377,7 @@ class TpuBackend:
                 results = fin()
             self.era_calls += 1
             self.era_slots_total += len(jobs)
-            metrics.inc("crypto_tpu_era_kernel_calls")
+            metrics.inc("crypto_tpu_era_kernel_calls_total")
             return results
 
         return finish
@@ -440,14 +440,14 @@ class TpuBackend:
         # pad-waste: fraction of the padded slot axis burnt on fully-masked
         # dummy slots — the number that explains bench variance and tunes
         # the batcher's max_slots_per_call
-        metrics.inc("crypto_tpu_era_route", labels={"path": path})
-        metrics.inc("crypto_tpu_era_slots_padded", s_pad - s)
-        metrics.observe_hist(
+        metrics.inc("crypto_tpu_era_route_total", labels={"path": path})
+        metrics.inc("crypto_tpu_era_slots_padded_total", s_pad - s)
+        metrics.observe_hist(  # lint-allow: metric-name dimensionless slot-count distribution
             "crypto_tpu_era_batch_slots",
             s,
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
         )
-        metrics.observe_hist(
+        metrics.observe_hist(  # lint-allow: metric-name dimensionless waste-fraction distribution
             "crypto_tpu_era_pad_waste",
             1.0 - s / s_pad,
             buckets=(0.0, 0.1, 0.2, 0.3, 0.4, 0.5),
@@ -521,5 +521,5 @@ class TpuBackend:
         )
         self.ts_era_calls += 1
         self.ts_era_coins_total += len(jobs)
-        metrics.inc("crypto_tpu_ts_era_kernel_calls")
+        metrics.inc("crypto_tpu_ts_era_kernel_calls_total")
         return results

@@ -48,6 +48,13 @@ class NetworkManager:
         # unrecoverable for the slot)
         self._undelivered: Dict[bytes, List[NetworkMessage]] = {}
         self._undelivered_cap = 2048
+        # trace-context trailers observed on verified inbound batches:
+        # era -> {trace id hex}. Bounded to the newest _TRACE_ERA_KEEP
+        # eras — the fleet merger only correlates recent eras, and a
+        # byzantine peer stamping absurd era numbers can at worst cycle
+        # this dict, never grow it (ids per era are bounded by peers)
+        self.era_trace_ids: Dict[int, set] = {}
+        self._TRACE_ERA_KEEP = 8
         # event handlers: fn(sender_pubkey, message)
         self.on_consensus: Optional[Callable[[bytes, int, object], None]] = None
         self.on_ping_request: Optional[Callable[[bytes, int], None]] = None
@@ -467,6 +474,7 @@ class NetworkManager:
         except (ValueError, zlib.error):
             logger.warning("corrupt batch content dropped")
             return
+        self._note_trace_ctx(batch)
         if conn_id is not None:
             # remember the latest live inbound connection per verified
             # sender: the reverse-delivery path to NAT'd relay clients.
@@ -481,6 +489,40 @@ class NetworkManager:
                 self._dispatch(batch.sender, msg)
             except Exception:
                 logger.exception("message handler failed")
+
+    def _note_trace_ctx(self, batch: MessageBatch) -> None:
+        """Record the sender's trace context from a VERIFIED batch: the
+        receiving node's consensus spans for that era can then carry the
+        peer's trace id (cross-node causality for RBC echo/ready and coin
+        shares in the merged fleet trace). First sighting of an id per era
+        emits a wire.trace_ctx instant; repeats are a set probe."""
+        ctx = batch.trace_trailer()
+        if ctx is None:
+            return
+        origin, era, tid = ctx
+        ids = self.era_trace_ids.get(era)
+        if ids is None:
+            ids = self.era_trace_ids[era] = set()
+            while len(self.era_trace_ids) > self._TRACE_ERA_KEEP:
+                del self.era_trace_ids[min(self.era_trace_ids)]
+        tid_hex = tid.hex()
+        if tid_hex not in ids:
+            ids.add(tid_hex)
+            from ..utils import tracing
+
+            tracing.instant(
+                "wire.trace_ctx",
+                cat="net",
+                era=era,
+                trace=tid_hex,
+                origin=origin.hex(),
+                sender=batch.sender.hex()[:16],
+            )
+
+    def trace_ids_for(self, era: int) -> List[str]:
+        """Trace ids seen on inbound consensus traffic for `era` (sorted
+        for deterministic span annotations)."""
+        return sorted(self.era_trace_ids.get(era, ()))
 
     def _dispatch(self, sender: bytes, msg: NetworkMessage) -> None:
         k = msg.kind

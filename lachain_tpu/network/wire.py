@@ -320,6 +320,40 @@ def parse_sync_pool_reply(msg: NetworkMessage) -> List[SignedTransaction]:
 # signed batches
 # ---------------------------------------------------------------------------
 
+# Trace-context trailer (fleet observability): a fixed-width suffix INSIDE
+# `content`, appended AFTER the zlib stream ends. Placement is the whole
+# design: `messages()` decompresses with a decompressobj, which stops at
+# the stream end and leaves trailing bytes in `unused_data` — so a
+# trailer-free decoder (any pre-trailer build) accepts the frame
+# unchanged, and the batch signature (over the full content bytes) covers
+# the trailer for free. DESIGN DIVERGENCE from a trailer "past the signed
+# region": appending after the signature'd field would trip the old
+# decoder's assert_eof and break mixed-version interop — inside-content
+# placement is the variant old peers actually tolerate, and an
+# authenticated trace context is strictly better than an unauthenticated
+# one. Layout (29 bytes):
+#   magic "LTRC" (4) | version 0x01 (1) | origin (8) | era i64 (8) |
+#   trace id (8)
+# origin = keccak256(sender pubkey)[:8]; trace id =
+# era_trace_id(sender, era) — both deterministic, so the fleet merger can
+# recompute them from the era report alone and match receiver-side
+# wire.trace_ctx instants without any coordination.
+TRACE_TRAILER_MAGIC = b"LTRC"
+TRACE_TRAILER_VERSION = 1
+TRACE_TRAILER_LEN = 4 + 1 + 8 + 8 + 8
+
+
+def node_trace_origin(pub: bytes) -> bytes:
+    """8-byte node lane id for the fleet trace (stable per pubkey)."""
+    return keccak256(pub)[:8]
+
+
+def era_trace_id(pub: bytes, era: int) -> bytes:
+    """The 8-byte trace id a node attaches to its era-`era` consensus
+    traffic. A pure function of (sender, era): every observer derives the
+    identical id, so cross-node causality needs no id exchange."""
+    return keccak256(pub + write_i64(era))[:8]
+
 
 @dataclass(frozen=True)
 class MessageBatch:
@@ -356,12 +390,37 @@ class MessageBatch:
         raw = d.decompress(self.content, 1 << 26)
         if d.unconsumed_tail or not d.eof:
             raise ValueError("batch too large")
+        # bytes past the zlib stream end land in d.unused_data and are
+        # IGNORED here by design: that tail is where the optional trace
+        # trailer rides (trace_trailer()), and ignoring unknown tails is
+        # what makes the trailer forward-compatible
         r = Reader(raw)
         out = []
         for _ in range(r.u32()):
             out.append(NetworkMessage.decode_from(r))
         r.assert_eof()
         return out
+
+    def trace_trailer(self) -> Optional[Tuple[bytes, int, bytes]]:
+        """Parse the optional trace-context trailer: (origin, era,
+        trace_id), or None when absent. O(1) — reads the content SUFFIX
+        without decompressing, so the receive hot path pays a 5-byte
+        compare per batch. A zlib stream coincidentally ending in the
+        magic+version bytes (2^-40) would yield a garbage-but-harmless
+        trace context; the trailer is observability-only and never feeds
+        consensus."""
+        c = self.content
+        if len(c) < TRACE_TRAILER_LEN:
+            return None
+        tail = c[len(c) - TRACE_TRAILER_LEN:]
+        if (
+            tail[:4] != TRACE_TRAILER_MAGIC
+            or tail[4] != TRACE_TRAILER_VERSION
+        ):
+            return None
+        origin = tail[5:13]
+        era = int.from_bytes(tail[13:21], "big", signed=True)
+        return origin, era, tail[21:29]
 
 
 class MessageFactory:
@@ -370,10 +429,33 @@ class MessageFactory:
     def __init__(self, ecdsa_priv: bytes):
         self._priv = ecdsa_priv
         self.public_key = ecdsa.public_key_bytes(ecdsa_priv)
+        # emit the trace-context trailer on consensus-bearing batches.
+        # On by default (the trailer is invisible to trailer-free
+        # decoders); tests flip it off to model a pre-trailer sender
+        self.trace_trailer = True
+        self._origin = node_trace_origin(self.public_key)
 
     def batch(self, msgs: List[NetworkMessage]) -> MessageBatch:
         raw = write_u32(len(msgs)) + b"".join(m.encode() for m in msgs)
         content = zlib.compress(raw, level=1)
+        if self.trace_trailer:
+            # era = the newest era among the batch's consensus messages
+            # (a flush batch can mix eras under pipelining; the receiver's
+            # per-era set keeps ids for every era it actually dispatches)
+            era = None
+            for m in msgs:
+                if m.kind == KIND_CONSENSUS and len(m.body) >= 8:
+                    e = int.from_bytes(m.body[:8], "big", signed=True)
+                    if era is None or e > era:
+                        era = e
+            if era is not None:
+                content += (
+                    TRACE_TRAILER_MAGIC
+                    + bytes([TRACE_TRAILER_VERSION])
+                    + self._origin
+                    + write_i64(era)
+                    + era_trace_id(self.public_key, era)
+                )
         sig = ecdsa.sign_hash(self._priv, keccak256(content))
         return MessageBatch(
             sender=self.public_key, signature=sig, content=content

@@ -169,6 +169,10 @@ class JsonRpcServer:
         )
         self._methods: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        # liveness probe provider: a zero-arg callable returning the health
+        # dict (Node.health). GET /healthz serves it WITHOUT the api key —
+        # orchestrators and load balancers probe without credentials.
+        self.health_fn: Optional[Callable[[], dict]] = None
 
     def register(self, name: str, fn: Callable) -> None:
         self._methods[name] = fn
@@ -228,6 +232,20 @@ class JsonRpcServer:
                     await self._respond(writer, 413, b"body too large")
                     return
                 body = await read(reader.readexactly(length)) if length else b""
+                if method.upper() == "GET" and _path.split("?", 1)[
+                    0
+                ].rstrip() in ("/healthz", "/healthz/"):
+                    # the ONE documented unauthenticated endpoint: liveness
+                    # probes run before secrets are provisioned, so /healthz
+                    # is served ahead of the api-key gate. It leaks only the
+                    # verdict plus coarse chain counters — never keys, peers'
+                    # addresses, or tx content. 503 on "stalled" lets dumb
+                    # HTTP probes (compose healthcheck, LBs) act on status
+                    # code alone.
+                    await self._respond_health(writer)
+                    if headers.get("connection", "").lower() == "close":
+                        return
+                    continue
                 # compare as bytes: compare_digest on str raises TypeError
                 # for non-ASCII input, which would be attacker-drivable
                 if self.api_key is not None and not hmac.compare_digest(
@@ -235,6 +253,8 @@ class JsonRpcServer:
                 ):
                     # key gates EVERYTHING, including the metrics scrape
                     await self._respond(writer, 403, b"bad api key")
+                    if headers.get("connection", "").lower() == "close":
+                        return
                     continue
                 if method.upper() == "GET" and _path.startswith("/metrics"):
                     # Prometheus scrape endpoint (reference MetricsService,
@@ -247,6 +267,8 @@ class JsonRpcServer:
                         _metrics.render_text().encode(),
                         ctype="text/plain; version=0.0.4",
                     )
+                    if headers.get("connection", "").lower() == "close":
+                        return
                     continue
                 if method.upper() != "POST":
                     await self._respond(writer, 405, b"POST only")
@@ -267,10 +289,38 @@ class JsonRpcServer:
             except Exception:
                 pass
 
+    async def _respond_health(self, writer) -> None:
+        if self.health_fn is None:
+            # no provider wired (bare server, tests): report liveness only
+            await self._respond(
+                writer,
+                200,
+                b'{"status": "ok", "detail": "no health provider"}',
+                ctype="application/json",
+            )
+            return
+        try:
+            report = self.health_fn()
+        except Exception:
+            logger.exception("health provider failed")
+            await self._respond(
+                writer, 503, b'{"status": "stalled", "detail": '
+                b'"health provider raised"}', ctype="application/json",
+            )
+            return
+        status = 503 if report.get("status") == "stalled" else 200
+        await self._respond(
+            writer,
+            status,
+            json.dumps(report).encode(),
+            ctype="application/json",
+        )
+
     @staticmethod
     async def _respond(writer, status, body: bytes, ctype="text/plain"):
         reason = {200: "OK", 403: "Forbidden", 405: "Method Not Allowed",
-                  413: "Payload Too Large"}.get(status, "?")
+                  413: "Payload Too Large",
+                  503: "Service Unavailable"}.get(status, "?")
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {ctype}\r\n"

@@ -572,6 +572,49 @@ class RpcService:
         n = int(limit, 16) if isinstance(limit, str) else limit
         return tracing.to_chrome_trace(limit=n)
 
+    def la_getTxTrace(self, tx_hash):
+        """Stamped lifecycle timeline for a SAMPLED transaction
+        (utils/txtrace.py): monotonic stage stamps submit→pool→propose→
+        decide→exec→commit as relative offsets, stage durations summing to
+        e2e_s. Returns {"sampled": false, ...} for a tx outside the sample
+        (or evicted from the bounded timeline LRU) so callers can
+        distinguish 'not sampled' from 'never seen'."""
+        from ..utils import txtrace
+
+        h = _bytes(tx_hash)
+        tl = txtrace.timeline(h)
+        if tl is not None:
+            return {"sampled": True, **tl}
+        return {
+            "sampled": False,
+            "hash": tx_hash,
+            "wouldSample": txtrace.sampled(h),
+            "sampleShift": txtrace.sample_shift(),
+        }
+
+    def la_time(self):
+        """Clock anchor for cross-node trace alignment: this node's
+        position on its exported Chrome ts axis plus its wall clock, both
+        in microseconds. A merger brackets the call with two local clock
+        reads and keeps the tightest bracket's midpoint (see
+        utils/fleetview.probe_offset) — cheap enough to ping repeatedly."""
+        import time as _time
+
+        from ..utils import tracing
+
+        return {
+            "traceUs": round(tracing.chrome_now_us(), 1),
+            "wallUs": round(_time.time() * 1e6, 1),
+        }
+
+    def la_getHealth(self):
+        """Health/SLO verdict (`ok|degraded|stalled`) with the counters
+        behind it: tip age, peer count, pool depth, commit lag vs the
+        fleet's median peer height, watchdog strikes. Same payload as the
+        unauthenticated GET /healthz, exposed here for JSON-RPC tooling
+        and the fleet-trace merger."""
+        return self.node.health()
+
     def la_getTraceSummary(self):
         """Per-span-name aggregate of the trace ring buffer:
         {name: {count, total_ms, max_ms, open}}."""
@@ -1346,7 +1389,7 @@ class RpcService:
         """Fast-sync progress counter (reference StateDownloader stats)."""
         from ..utils import metrics as _metrics
 
-        return int(_metrics.counter_value("fastsync_nodes_downloaded"))
+        return int(_metrics.counter_value("fastsync_nodes_downloaded_total"))
 
     def _height_for_tag(self, tag):
         # _tag_to_height with a None-on-garbage contract (the version-keyed

@@ -531,7 +531,7 @@ class Trie:
             h0 = time.perf_counter()
             hashes = keccak256_batch(encs, nthreads)
             hash_s += time.perf_counter() - h0
-            metrics.observe_hist(
+            metrics.observe_hist(  # lint-allow: metric-name dimensionless batch-size distribution
                 "trie_keccak_batch_size",
                 len(encs),
                 buckets=_KECCAK_BATCH_BUCKETS,

@@ -1,0 +1,316 @@
+"""Fleet-wide observability merge: ONE Chrome trace for N nodes.
+
+Each node exports its own trace ring over RPC (`la_getTrace`) with
+timestamps on its private monotonic axis — useless side by side until
+the axes are aligned. This module scrapes every node, aligns clocks by
+RTT-bracketed `la_time` pings (keep the tightest bracket, take its
+midpoint — the over-the-wire analogue of tracing.clock_offset), and
+emits a single Chrome trace_event JSON where every node keeps its own
+pid lane block. A sampled transaction's `tx.*` lifecycle instants and
+the deterministic per-era wire trace ids (network/wire.era_trace_id)
+then line up ACROSS lanes: search the merged trace for the 16-hex-char
+trace id and Perfetto highlights the tx's submit→pool→propose→decide→
+exec→commit path on whichever nodes touched it.
+
+Also builds the fleet era table: per-node era wall/phase durations from
+`la_getEraReport`, with per-phase skew (max−min across validators) and
+slowest-validator attribution — the first question of any consensus
+latency hunt ("WHO is the straggler, and in which phase?") answered
+without eyeballing N separate reports.
+
+Stdlib-only (urllib): the merger must run from an operator laptop or a
+CI step with no extra dependencies.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# pid namespacing: node i owns [_PID_STRIDE*(i+1), _PID_STRIDE*(i+2)) in
+# the merged trace; within the block, the node's original pids (python
+# host = 1, native engines = 2+) keep their relative positions
+_PID_STRIDE = 100
+
+
+def _rpc(
+    url: str,
+    method: str,
+    params: Sequence = (),
+    timeout: float = 10.0,
+    api_key: Optional[str] = None,
+):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+    ).encode()
+    headers = {"Content-Type": "application/json"}
+    if api_key:
+        headers["X-Api-Key"] = api_key
+    req = urllib.request.Request(url, data=body, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(
+            f"{method} on {url}: {out['error'].get('message', out['error'])}"
+        )
+    return out["result"]
+
+
+def probe_offset(
+    url: str,
+    samples: int = 5,
+    timeout: float = 10.0,
+    api_key: Optional[str] = None,
+    _call=None,
+) -> Dict[str, float]:
+    """Microseconds to ADD to the node's Chrome ts axis to land on the
+    merger's local monotonic axis, found by RTT bracketing: read the
+    local clock, ping `la_time`, read again; the node's answer happened
+    somewhere inside the bracket, so the tightest bracket's midpoint is
+    the best alignment and half its width bounds the error. `_call`
+    is a test seam (same signature as the la_time round trip)."""
+    call = _call or (
+        lambda: _rpc(url, "la_time", timeout=timeout, api_key=api_key)
+    )
+    best_width = None
+    best = {"offset_us": 0.0, "uncertainty_us": 0.0, "wall_skew_us": 0.0}
+    for _ in range(max(samples, 1)):
+        m0 = time.monotonic() * 1e6
+        w0 = time.time() * 1e6
+        res = call()
+        m1 = time.monotonic() * 1e6
+        w1 = time.time() * 1e6
+        width = m1 - m0
+        if best_width is None or width < best_width:
+            best_width = width
+            best = {
+                "offset_us": round((m0 + m1) / 2 - float(res["traceUs"]), 1),
+                "uncertainty_us": round(width / 2, 1),
+                # wall skew is diagnostic only (NTP drift between hosts);
+                # the merge itself never trusts wall clocks
+                "wall_skew_us": round(
+                    (w0 + w1) / 2 - float(res["wallUs"]), 1
+                ),
+            }
+    return best
+
+
+def scrape_node(
+    url: str,
+    name: str,
+    samples: int = 5,
+    timeout: float = 10.0,
+    api_key: Optional[str] = None,
+) -> Dict[str, object]:
+    """One node's full observability snapshot. Offset is probed FIRST
+    (before the heavy trace download) so the brackets stay tight. Parts
+    degrade independently: a node with tracing disabled still lands in
+    the era table, a health endpoint mid-restart still leaves the trace
+    usable — each failed part is recorded under "errors"."""
+    out: Dict[str, object] = {
+        "url": url,
+        "name": name,
+        "offset": None,
+        "trace": None,
+        "eraReport": None,
+        "health": None,
+        "errors": {},
+    }
+    errors: Dict[str, str] = out["errors"]  # type: ignore[assignment]
+    try:
+        out["offset"] = probe_offset(
+            url, samples=samples, timeout=timeout, api_key=api_key
+        )
+    except Exception as e:  # noqa: BLE001 — record and degrade
+        errors["offset"] = str(e)
+    for key, method in (
+        ("trace", "la_getTrace"),
+        ("eraReport", "la_getEraReport"),
+        ("health", "la_getHealth"),
+    ):
+        try:
+            out[key] = _rpc(url, method, timeout=timeout, api_key=api_key)
+        except Exception as e:  # noqa: BLE001
+            errors[key] = str(e)
+    return out
+
+
+def merge_traces(nodes: List[Dict[str, object]]) -> dict:
+    """Fold per-node Chrome traces into one. Every event's pid moves into
+    its node's pid block, its ts shifts by the node's probed offset onto
+    the merger's axis, and the whole fleet is re-based so the earliest
+    event sits at ts=0. Nodes whose offset probe failed keep offset 0 —
+    their lane renders, visibly mis-aligned, rather than disappearing.
+
+    The returned dict is valid Chrome trace JSON; the extra top-level
+    "fleet" key (per-node pid base, offset, uncertainty, health verdict)
+    is ignored by viewers and consumed by the era table / CI tooling."""
+    events: List[dict] = []
+    meta: List[dict] = []
+    fleet: List[dict] = []
+    for i, node in enumerate(nodes):
+        base = _PID_STRIDE * (i + 1)
+        offset = node.get("offset") or {}
+        off_us = float(offset.get("offset_us", 0.0))
+        health = node.get("health") or {}
+        fleet.append(
+            {
+                "name": node["name"],
+                "url": node.get("url"),
+                "pidBase": base,
+                "offsetUs": off_us,
+                "uncertaintyUs": offset.get("uncertainty_us"),
+                "wallSkewUs": offset.get("wall_skew_us"),
+                "status": health.get("status"),
+                "errors": node.get("errors") or {},
+            }
+        )
+        trace = node.get("trace") or {}
+        named_pids = set()
+        for ev in trace.get("traceEvents", ()):
+            ev = dict(ev)
+            ev["pid"] = base + int(ev.get("pid", 0))
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    # lane labels carry the node name: "node2 python-host"
+                    args = dict(ev.get("args") or {})
+                    args["name"] = f"{node['name']} {args.get('name', '')}"
+                    ev["args"] = args
+                    named_pids.add(ev["pid"])
+                meta.append(ev)
+                continue
+            ev["ts"] = float(ev.get("ts", 0.0)) + off_us
+            events.append(ev)
+        # nodes emitting events on a pid with no process_name meta would
+        # render as an anonymous lane — synthesize a label
+        for pid in sorted(
+            {e["pid"] for e in events if base <= e["pid"] < base + _PID_STRIDE}
+            - named_pids
+        ):
+            meta.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {
+                        "name": f"{node['name']} pid{pid - base}"
+                    },
+                }
+            )
+    if events:
+        t0 = min(e["ts"] for e in events)
+        for ev in events:
+            ev["ts"] = round(ev["ts"] - t0, 1)
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "fleet": {"nodes": fleet},
+    }
+
+
+def fleet_era_report(nodes: List[Dict[str, object]]) -> dict:
+    """Cross-validator era comparison from the per-node era reports:
+    for every era any node completed, the per-node wall time, the
+    slowest validator (the straggler consensus waits on), and per-phase
+    skew (max−min across the nodes that saw the era — a phase with high
+    skew on low mean is one validator's private problem, not a fleet
+    regression)."""
+    per_era: Dict[int, Dict[str, dict]] = {}
+    phases: List[str] = []
+    for node in nodes:
+        rep = node.get("eraReport") or {}
+        for p in rep.get("phases", ()):
+            if p not in phases:
+                phases.append(p)
+        for ent in rep.get("eras", ()):
+            per_era.setdefault(int(ent["era"]), {})[
+                str(node["name"])
+            ] = ent
+    eras = []
+    for era in sorted(per_era):
+        by_node = per_era[era]
+        walls = {n: float(e["wall_s"]) for n, e in by_node.items()}
+        slowest = max(walls, key=walls.get)  # type: ignore[arg-type]
+        phase_skew = {}
+        for p in phases:
+            vals = [
+                float((e.get("phases_s") or {}).get(p, 0.0))
+                for e in by_node.values()
+            ]
+            phase_skew[p] = round(max(vals) - min(vals), 6) if vals else 0.0
+        worst_phase = (
+            max(phase_skew, key=phase_skew.get) if phase_skew else None
+        )
+        eras.append(
+            {
+                "era": era,
+                "wall_s": {n: round(w, 6) for n, w in walls.items()},
+                "slowest": slowest,
+                "wall_skew_s": round(
+                    max(walls.values()) - min(walls.values()), 6
+                ),
+                "phase_skew_s": phase_skew,
+                "worst_phase": worst_phase,
+            }
+        )
+    return {"eras": eras, "phases": phases}
+
+
+def fleet_era_table(report: dict) -> str:
+    """Plain-text rendering of fleet_era_report for the CLI."""
+    eras = report.get("eras", [])
+    if not eras:
+        return "<no completed eras reported by any node>"
+    names = sorted({n for ent in eras for n in ent["wall_s"]})
+    cols = (
+        ["era"]
+        + [f"{n}_wall_s" for n in names]
+        + ["skew_s", "slowest", "worst_phase", "phase_skew_s"]
+    )
+    rows = [cols]
+    for ent in eras:
+        wp = ent.get("worst_phase")
+        rows.append(
+            [str(ent["era"])]
+            + [
+                f"{ent['wall_s'][n]:.3f}" if n in ent["wall_s"] else "-"
+                for n in names
+            ]
+            + [
+                f"{ent['wall_skew_s']:.3f}",
+                str(ent["slowest"]),
+                str(wp or "-"),
+                f"{ent['phase_skew_s'].get(wp, 0.0):.3f}" if wp else "-",
+            ]
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = [
+        "  ".join(cell.rjust(widths[i]) for i, cell in enumerate(row))
+        for row in rows
+    ]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def collect(
+    urls: Sequence[str],
+    names: Optional[Sequence[str]] = None,
+    samples: int = 5,
+    timeout: float = 10.0,
+    api_key: Optional[str] = None,
+) -> Tuple[dict, dict]:
+    """Scrape + merge in one call: returns (merged_chrome_trace,
+    fleet_era_report). Node names default to node0..nodeN-1 in URL
+    order — pass explicit names to match deployment labels."""
+    if names is None:
+        names = [f"node{i}" for i in range(len(urls))]
+    nodes = [
+        scrape_node(
+            url, name, samples=samples, timeout=timeout, api_key=api_key
+        )
+        for url, name in zip(urls, names)
+    ]
+    return merge_traces(nodes), fleet_era_report(nodes)

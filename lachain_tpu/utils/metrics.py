@@ -46,6 +46,30 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 )
 
 
+# Cardinality backstop: distinct label sets admitted per metric name (per
+# kind). Label values derived from attacker- or workload-controlled input
+# (peer ids, method names, stages) must not grow the registry — and the
+# scrape payload — without bound. Past the cap, NEW label sets are dropped
+# and counted in the unlabeled metrics_labels_dropped_total; existing
+# series keep updating.
+MAX_LABEL_SETS = 256
+_series_counts: Dict[Tuple[str, str], int] = {}  # (kind, name) -> sets
+_DROPPED_KEY = ("metrics_labels_dropped_total", ())
+
+
+def _admit(kind: str, name: str) -> bool:
+    """Called under _lock when a labeled series would be CREATED: admit
+    while the (kind, name) family is under MAX_LABEL_SETS, else count the
+    drop and refuse."""
+    k = (kind, name)
+    n = _series_counts.get(k, 0)
+    if n >= MAX_LABEL_SETS:
+        _counters[_DROPPED_KEY] = _counters.get(_DROPPED_KEY, 0.0) + 1.0
+        return False
+    _series_counts[k] = n + 1
+    return True
+
+
 def _label_key(labels: Optional[dict]) -> tuple:
     if not labels:
         return ()
@@ -126,6 +150,11 @@ def histogram(
         with _lock:
             h = _histograms.get(key)
             if h is None:
+                if key[1] and not _admit("histogram", name):
+                    # over the cardinality cap: hand back a detached
+                    # histogram (observations land nowhere, callers keep
+                    # working) instead of registering a new series
+                    return Histogram(name, buckets, key[1])
                 h = Histogram(name, buckets, key[1])
                 _histograms[key] = h
     return h
@@ -180,14 +209,23 @@ def inc(
 ) -> None:
     key = (name, _label_key(labels))
     with _lock:
+        if (
+            key[1]
+            and key not in _counters
+            and not _admit("counter", name)
+        ):
+            return
         _counters[key] = _counters.get(key, 0.0) + amount
 
 
 def set_gauge(
     name: str, value: float, labels: Optional[dict] = None
 ) -> None:
+    key = (name, _label_key(labels))
     with _lock:
-        _gauges[(name, _label_key(labels))] = value
+        if key[1] and key not in _gauges and not _admit("gauge", name):
+            return
+        _gauges[key] = value
 
 
 def counter_value(name: str, labels: Optional[dict] = None) -> float:
@@ -309,4 +347,5 @@ def reset_all_for_tests() -> None:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+        _series_counts.clear()
         MESSAGES_PROCESSED[0] = 0

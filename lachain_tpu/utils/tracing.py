@@ -179,6 +179,14 @@ def snapshot(limit: Optional[int] = None) -> List[dict]:
     return out
 
 
+def chrome_now_us() -> float:
+    """'Now' on the exported Chrome ts axis (microseconds since this
+    tracer's epoch). The anchor `la_time` serves so a fleet merger can
+    align this node's trace axis with its own clock by RTT bracketing —
+    the cross-node analogue of clock_offset()."""
+    return (time.monotonic() - _epoch) * 1e6
+
+
 # -- native flight-recorder merge --------------------------------------------
 
 

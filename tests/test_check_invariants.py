@@ -356,3 +356,59 @@ def test_clean_head_has_zero_violations(capsys):
     cap = capsys.readouterr()
     assert rc == 0, cap.out
     assert "0 violation(s)" in cap.err
+
+
+# -- rule M: metric-name hygiene ---------------------------------------------
+
+
+def test_metric_names_require_typed_suffix(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "rpc/evil_metrics.py": """
+            from ..utils import metrics
+
+            def handle():
+                metrics.inc("requests_served")
+                metrics.observe_hist("request_latency", 0.1)
+                metrics.histogram("queue_wait")
+        """,
+    }, capsys)
+    assert rc == 1
+    assert out.count("[metric-name]") == 3
+    assert "counter 'requests_served'" in out
+    assert "histogram 'request_latency'" in out
+    assert "_total/_seconds/_bytes" in out
+
+
+def test_metric_names_with_suffix_and_gauges_are_clean(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "rpc/good_metrics.py": """
+            from ..utils import metrics as _metrics
+
+            def handle(peer):
+                _metrics.inc("requests_served_total")
+                _metrics.observe_hist("request_latency_seconds", 0.1)
+                _metrics.observe_hist("reply_size_bytes", 512.0)
+                # gauges are the documented exception: no suffix required
+                _metrics.set_gauge("pool_depth", 7.0)
+                # dynamic names are reviewed by humans, not the linter
+                _metrics.inc("peer_" + peer)
+                # .inc on a non-metrics object is not a metric mint
+                peer.inc("whatever")
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
+def test_metric_name_lint_allow_escape(tmp_path, capsys):
+    rc, out, err = run_lint(tmp_path, {
+        "rpc/allowed_metrics.py": """
+            from ..utils import metrics
+
+            def handle():
+                metrics.observe_hist(  # lint-allow: metric-name dimensionless slot count
+                    "flush_slots", 4.0
+                )
+        """,
+    }, capsys)
+    assert rc == 0, out
+    assert "1 lint-allow line(s)" in err

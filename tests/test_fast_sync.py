@@ -246,12 +246,12 @@ def test_multi_peer_sync_survives_kill_then_joins_consensus():
         fs.request_timeout = 1.0
         serving = [pub.ecdsa_pub_keys[i] for i in (0, 1, 2)]
         victim = validators[0]
-        base_nodes = metrics.counter_value("fastsync_nodes_downloaded")
+        base_nodes = metrics.counter_value("fastsync_nodes_downloaded_total")
         base_fail = metrics.counter_value("fastsync_failovers_total")
 
         task = asyncio.create_task(fs.sync(serving, timeout=60))
         # kill one serving peer mid-download
-        await _wait_counter("fastsync_nodes_downloaded", base_nodes, 2_000)
+        await _wait_counter("fastsync_nodes_downloaded_total", base_nodes, 2_000)
         _kill(victim)
         synced = await task
         assert synced == 1
@@ -403,11 +403,11 @@ def test_sync_aborts_only_when_no_peer_remains():
         fs = obs.fast_sync
         fs.request_timeout = 0.3
         fs.peer_death_threshold = 2
-        base = metrics.counter_value("fastsync_nodes_downloaded")
+        base = metrics.counter_value("fastsync_nodes_downloaded_total")
         task = asyncio.create_task(
             fs.sync([pub.ecdsa_pub_keys[0], pub.ecdsa_pub_keys[1]], timeout=30)
         )
-        await _wait_counter("fastsync_nodes_downloaded", base, 256)
+        await _wait_counter("fastsync_nodes_downloaded_total", base, 256)
         for s in servers:
             _kill(s)
         with pytest.raises(ValueError, match="no live serving peers remain"):
@@ -469,10 +469,10 @@ def test_two_run_outcome_determinism_under_seeded_faults():
             )
             fs = obs.fast_sync
             fs.request_timeout = 0.5
-            base = metrics.counter_value("fastsync_nodes_downloaded")
+            base = metrics.counter_value("fastsync_nodes_downloaded_total")
             synced = await fs.sync(peers, timeout=10)
             downloaded = (
-                metrics.counter_value("fastsync_nodes_downloaded") - base
+                metrics.counter_value("fastsync_nodes_downloaded_total") - base
             )
             outcomes.append(
                 (synced, obs.state.committed.state_hash(), downloaded)
@@ -504,7 +504,7 @@ def test_snapshot_sync_resumes_across_peer_kill():
         fs.request_timeout = 1.0
         fs.snapshot_page = 2_048
         base_pages = metrics.counter_value("fastsync_snapshot_pages_total")
-        base_nodes = metrics.counter_value("fastsync_nodes_downloaded")
+        base_nodes = metrics.counter_value("fastsync_nodes_downloaded_total")
         base_fail = metrics.counter_value("fastsync_failovers_total")
         task = asyncio.create_task(
             fs.sync(
@@ -520,7 +520,7 @@ def test_snapshot_sync_resumes_across_peer_kill():
         _spot_check_balances(obs, 20_000)
         # the bulk path carried the state: the walk downloaded ~nothing
         assert (
-            metrics.counter_value("fastsync_nodes_downloaded") - base_nodes
+            metrics.counter_value("fastsync_nodes_downloaded_total") - base_nodes
             < 1_000
         )
         assert metrics.counter_value("fastsync_failovers_total") > base_fail
@@ -548,7 +548,7 @@ def test_snapshot_falls_back_to_node_by_node():
         obs = await _observer(pub, seed=82)
         _join(obs, servers)
         base_rec = metrics.counter_value("fastsync_snapshot_records_total")
-        base_nodes = metrics.counter_value("fastsync_nodes_downloaded")
+        base_nodes = metrics.counter_value("fastsync_nodes_downloaded_total")
         synced = await obs.fast_sync.sync(
             [pub.ecdsa_pub_keys[0], pub.ecdsa_pub_keys[1]],
             timeout=30,
@@ -561,7 +561,7 @@ def test_snapshot_falls_back_to_node_by_node():
             == base_rec
         )
         assert (
-            metrics.counter_value("fastsync_nodes_downloaded") - base_nodes
+            metrics.counter_value("fastsync_nodes_downloaded_total") - base_nodes
             > 1_000
         )
         await _stop_all(servers + [obs])
@@ -713,11 +713,11 @@ def test_fast_sync_survives_real_sigkill():
             obs.connect(addrs)
             fs = obs.fast_sync
             fs.request_timeout = 1.0
-            base = metrics.counter_value("fastsync_nodes_downloaded")
+            base = metrics.counter_value("fastsync_nodes_downloaded_total")
             task = asyncio.create_task(
                 fs.sync([a.public_key for a in addrs], timeout=60)
             )
-            await _wait_counter("fastsync_nodes_downloaded", base, 2_000)
+            await _wait_counter("fastsync_nodes_downloaded_total", base, 2_000)
             os.kill(procs[0].pid, signal.SIGKILL)
             synced = await task
             assert synced == 1

@@ -1,0 +1,188 @@
+"""Fleet observability acceptance: a 4-process devnet campaign whose
+sampled transaction is traceable END TO END — la_getTxTrace reports a
+monotonic submit→commit timeline on the submitting node, and the merged
+fleet Chrome trace (utils/fleetview over all four RPCs) carries the tx's
+trace id across multiple node pid lanes. The merged trace is written to
+$LACHAIN_FLEET_TRACE_DIR when set (the CI chaos job uploads it on
+failure)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from lachain_tpu.core.types import Transaction, sign_transaction
+from lachain_tpu.crypto import ecdsa
+
+PORT_BASE = 7350
+CHAIN = 225
+
+
+def rpc(port, method, *params, timeout=5):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": list(params)}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+@pytest.mark.slow
+def test_fleet_trace_campaign(tmp_path):
+    user = ecdsa.generate_private_key()
+    uaddr = ecdsa.address_from_public_key(ecdsa.public_key_bytes(user))
+    netdir = tmp_path / "net"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", LOG_LEVEL="WARNING")
+    subprocess.run(
+        [
+            sys.executable, "-m", "lachain_tpu.cli", "keygen",
+            "--n", "4", "--f", "1", "--out", str(netdir),
+            "--port-base", str(PORT_BASE),
+            "--block-time-ms", "200",
+            "--fund", "0x" + uaddr.hex(),
+        ],
+        check=True, env=env, timeout=120,
+    )
+    # sample EVERY tx: the campaign's one transfer must land in the trace
+    for i in range(4):
+        p = netdir / f"config{i}.json"
+        cfg = json.loads(p.read_text())
+        cfg["observability"] = {"txSampleShift": 0}
+        p.write_text(json.dumps(cfg))
+
+    rpc_ports = [PORT_BASE + 2 * i + 1 for i in range(4)]
+    procs = []
+    try:
+        for i in range(4):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "lachain_tpu.cli", "run",
+                        "--config", str(netdir / f"config{i}.json"),
+                    ],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            )
+        # consensus must be live before the tx goes in
+        deadline = time.time() + 120
+        height = -1
+        while time.time() < deadline:
+            try:
+                height = int(rpc(rpc_ports[0], "eth_blockNumber"), 16)
+                if height >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert height >= 2, f"devnet never produced blocks (height={height})"
+
+        # keyless liveness probe answers on a producing node
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rpc_ports[0]}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] in ("ok", "degraded")
+
+        stx = sign_transaction(
+            Transaction(
+                to=b"\x0d" * 20, value=77, nonce=0, gas_price=1,
+                gas_limit=21000,
+            ),
+            user,
+            CHAIN,
+        )
+        tx_hash = rpc(
+            rpc_ports[0], "eth_sendRawTransaction", "0x" + stx.encode().hex()
+        )
+
+        # the submitting node's lifecycle timeline must reach commit
+        trace = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            t = rpc(rpc_ports[0], "la_getTxTrace", tx_hash)
+            if t.get("sampled") and any(
+                s["stage"] == "commit" for s in t["stages"]
+            ):
+                trace = t
+                break
+            time.sleep(1.0)
+        assert trace is not None, "tx never reached commit in the trace"
+        stages = [s["stage"] for s in trace["stages"]]
+        assert stages[0] == "submit" and stages[-1] == "commit"
+        assert {"pool", "decide", "exec"} <= set(stages)
+        ats = [s["at_s"] for s in trace["stages"]]
+        assert ats == sorted(ats), f"timeline not monotonic: {trace}"
+        # stage durations account for the whole e2e span (within 10%)
+        total = sum(s["dur_s"] for s in trace["stages"])
+        assert abs(total - trace["e2e_s"]) <= max(0.1 * trace["e2e_s"], 1e-3)
+
+        # merge the whole fleet into ONE Chrome trace
+        from lachain_tpu.utils import fleetview
+
+        urls = [f"http://127.0.0.1:{p}/" for p in rpc_ports]
+        merged, report = fleetview.collect(urls, samples=3, timeout=10.0)
+        out_dir = os.environ.get("LACHAIN_FLEET_TRACE_DIR") or str(tmp_path)
+        os.makedirs(out_dir, exist_ok=True)
+        out_path = os.path.join(out_dir, "campaign_fleet_trace.json")
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+
+        # every node scraped cleanly and got its own pid lane block
+        fleet = merged["fleet"]["nodes"]
+        assert [n["pidBase"] for n in fleet] == [100, 200, 300, 400]
+        assert all(not n["errors"] for n in fleet), fleet
+        assert all(n["status"] in ("ok", "degraded") for n in fleet), fleet
+
+        # THE acceptance: the tx's trace id appears as tx.* lifecycle
+        # instants in at least two different nodes' pid lanes
+        tid = trace["traceId"]
+        lanes = {
+            ev["pid"] // 100
+            for ev in merged["traceEvents"]
+            if ev.get("ph") != "M"
+            and str(ev.get("name", "")).startswith("tx.")
+            and (ev.get("args") or {}).get("trace") == tid
+        }
+        assert len(lanes) >= 2, (
+            f"trace id {tid} seen only in lanes {lanes}"
+        )
+        # the era skew table renders from the same scrape
+        assert report["eras"], "no node reported a completed era"
+        table = fleetview.fleet_era_table(report)
+        assert "slowest" in table.splitlines()[0]
+
+        # the operator CLI drives the same path end to end
+        cli_out = tmp_path / "cli_merged.json"
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "lachain_tpu.cli", "fleet-trace",
+                "--rpc", *urls, "--samples", "2",
+                "--out", str(cli_out),
+            ],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "slowest" in r.stdout
+        cli_merged = json.loads(cli_out.read_text())
+        assert cli_merged["fleet"]["nodes"]
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -358,3 +358,31 @@ def test_compare_checked_in_baseline_self_gate():
 
     base = os.path.join(os.path.dirname(__file__), "..", "BENCH_r05.json")
     assert compare.main([base, base]) == 0
+
+
+def test_compare_gates_tx_e2e_percentiles(tmp_path):
+    """The sampled tx e2e percentiles gate like any latency field: a p99
+    regression beyond the noise threshold fails even when the headline
+    throughput held steady."""
+    base = _result()
+    base.update(tx_e2e_p50_s=0.20, tx_e2e_p99_s=0.50)
+    bad = _result()
+    bad.update(tx_e2e_p50_s=0.21, tx_e2e_p99_s=1.00)
+    assert _gate(tmp_path, base, bad) == 1
+    ok = _result()
+    ok.update(tx_e2e_p50_s=0.20, tx_e2e_p99_s=0.51)
+    assert _gate(tmp_path, base, ok) == 0
+
+
+def test_compare_skips_absent_or_null_tx_percentiles(tmp_path):
+    """A run with tracing sampled out (tx_e2e_* null) or an old baseline
+    without the fields must not trip the gate on them."""
+    base = _result()
+    cur = _result()
+    cur.update(tx_e2e_p50_s=0.2, tx_e2e_p99_s=0.5)
+    assert _gate(tmp_path, base, cur) == 0
+    null_base = _result()
+    null_base.update(tx_e2e_p50_s=None, tx_e2e_p99_s=None)
+    worse_but_null = _result()
+    worse_but_null.update(tx_e2e_p50_s=None, tx_e2e_p99_s=None)
+    assert _gate(tmp_path, null_base, worse_but_null) == 0

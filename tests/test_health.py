@@ -1,0 +1,162 @@
+"""Node health verdict (ok|degraded|stalled) and the unauthenticated
+GET /healthz liveness surface: the verdict must flip ok -> stalled when
+commits stop and recover to ok on the next persisted block."""
+import asyncio
+import random
+
+import pytest
+
+from lachain_tpu.consensus.keys import trusted_key_gen
+from lachain_tpu.core.node import Node
+from lachain_tpu.core.types import BlockHeader, MultiSig, tx_merkle_root
+from lachain_tpu.core.vault import PrivateWallet
+
+pytestmark = pytest.mark.observability
+
+CHAIN = 533
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _solo_node():
+    """Single-validator node: expected_peers == 0, so peerlessness is not
+    a symptom and the verdict is driven by commits/strikes alone."""
+    pub, privs = trusted_key_gen(1, 0, rng=Rng(3))
+    wallet = PrivateWallet(ecdsa_priv=privs[0].ecdsa_priv)
+
+    async def build():
+        return Node(
+            index=0,
+            public_keys=pub,
+            private_keys=privs[0],
+            chain_id=CHAIN,
+            wallet=wallet,
+        )
+
+    return asyncio.run(build())
+
+
+def _produce_empty(node):
+    bm = node.block_manager
+    height = bm.current_height() + 1
+    em = bm.emulate([], height)
+    prev = bm.block_by_height(height - 1)
+    header = BlockHeader(
+        index=height,
+        prev_block_hash=prev.hash(),
+        merkle_root=tx_merkle_root([]),
+        state_hash=em.state_hash,
+        nonce=height,
+    )
+    return bm.execute_block(header, [], MultiSig(()))
+
+
+def test_health_verdict_flips_and_recovers():
+    node = _solo_node()
+    h = node.health()
+    assert h["status"] == "ok"
+    assert h["height"] == 0 and h["stallStrikes"] == 0
+    assert h["peerCount"] == 0  # solo: peerless is fine
+    # tip older than stall_timeout: degraded
+    node._last_commit_mono -= node.stall_timeout + 1
+    assert node.health()["status"] == "degraded"
+    # older than 2x: stalled
+    node._last_commit_mono -= node.stall_timeout + 1
+    h = node.health()
+    assert h["status"] == "stalled"
+    assert h["tipAgeSeconds"] > 2 * node.stall_timeout
+    # a persisted block refreshes the commit clock AND clears strikes
+    node._stall_stage = 2
+    _produce_empty(node)
+    h = node.health()
+    assert h["status"] == "ok"
+    assert h["height"] == 1 and h["stallStrikes"] == 0
+
+
+def test_watchdog_strikes_escalate_verdict():
+    node = _solo_node()
+    node._stall_stage = 1
+    assert node.health()["status"] == "degraded"
+    node._stall_stage = 2
+    assert node.health()["status"] == "stalled"
+    # native watchdog strikes count the same way
+    node._stall_stage = 0
+    node._native_watch = ("rbc", 0.0, 2)
+    h = node.health()
+    assert h["status"] == "stalled" and h["stallStrikes"] == 2
+
+
+def test_expected_peers_missing_reads_degraded():
+    pub, privs = trusted_key_gen(4, 1, rng=Rng(5))
+    wallet = PrivateWallet(ecdsa_priv=privs[0].ecdsa_priv)
+
+    async def build():
+        return Node(
+            index=0,
+            public_keys=pub,
+            private_keys=privs[0],
+            chain_id=CHAIN,
+            wallet=wallet,
+        )
+
+    node = asyncio.run(build())
+    # 4 validators configured, zero peers connected: degraded, not stalled
+    assert node.health()["status"] == "degraded"
+
+
+def test_behind_fleet_median_reads_degraded():
+    node = _solo_node()
+    node.synchronizer.peer_heights.update({b"a": 40, b"b": 50, b"c": 60})
+    h = node.health()
+    assert h["status"] == "degraded"
+    assert h["medianPeerHeight"] == 50 and h["commitLagVsPeers"] == 50
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nConnection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+def test_healthz_http_flip_on_gated_server():
+    """End-to-end through the HTTP layer: a keyless probe tracks the
+    node's verdict on a server whose api key gates everything else."""
+    import json
+
+    node = _solo_node()
+
+    async def run():
+        server = await node.start_rpc(api_key="sekrit")
+        try:
+            status, body = await _get(server.port, "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            node._last_commit_mono -= 2 * node.stall_timeout + 2
+            status, body = await _get(server.port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "stalled"
+            _produce_empty(node)
+            status, body = await _get(server.port, "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            # the key still gates the metrics scrape on the same server
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
+            writer.write(b"GET /metrics HTTP/1.1\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            assert b"403" in raw.split(b"\r\n", 1)[0]
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

@@ -134,3 +134,60 @@ def test_protocol_breadcrumbs():
     assert proto.last_activity >= proto.started_at
     snap_counters = metrics.render_text()
     assert "consensus_messages_processed" in snap_counters
+
+
+def test_label_cardinality_cap():
+    """An attacker-drivable label value (peer id, method name probe) must
+    not grow a metric family without bound: past MAX_LABEL_SETS new label
+    sets are dropped and counted, existing series keep updating."""
+    metrics.reset_all_for_tests()
+    cap = metrics.MAX_LABEL_SETS
+    for i in range(cap + 50):
+        metrics.inc("evil_counter_total", labels={"peer": f"p{i}"})
+    # first `cap` series exist; the overflow landed in the drop counter
+    assert metrics.counter_value("evil_counter_total", {"peer": "p0"}) == 1.0
+    assert (
+        metrics.counter_value("evil_counter_total", {"peer": f"p{cap + 10}"})
+        == 0.0
+    )
+    assert metrics.counter_value("metrics_labels_dropped_total") == 50.0
+    # admitted series still update after the cap is hit
+    metrics.inc("evil_counter_total", labels={"peer": "p0"})
+    assert metrics.counter_value("evil_counter_total", {"peer": "p0"}) == 2.0
+    # exposition stays bounded
+    text = metrics.render_text()
+    assert text.count('evil_counter_total{') == cap
+    assert "metrics_labels_dropped_total 50" in text
+    metrics.reset_all_for_tests()
+
+
+def test_label_cap_per_family_and_kinds_independent():
+    metrics.reset_all_for_tests()
+    cap = metrics.MAX_LABEL_SETS
+    for i in range(cap):
+        metrics.inc("family_a_total", labels={"x": str(i)})
+    # family_a is full; family_b and gauges/histograms admit fresh sets
+    metrics.inc("family_b_total", labels={"x": "new"})
+    assert metrics.counter_value("family_b_total", {"x": "new"}) == 1.0
+    metrics.set_gauge("family_a_depth", 3.0, labels={"x": "g"})
+    assert ("family_a_depth", (("x", "g"),)) in metrics._gauges
+    # over-cap histogram label sets return a DETACHED histogram: callers
+    # keep observing, nothing registers
+    for i in range(cap):
+        metrics.observe_hist("family_h_seconds", 0.1, labels={"x": str(i)})
+    before = len(metrics._histograms)
+    h = metrics.histogram("family_h_seconds", labels={"x": "overflow"})
+    h.observe(0.5)  # must not raise
+    assert len(metrics._histograms) == before
+    assert (
+        metrics.histogram_snapshot("family_h_seconds", {"x": "overflow"})
+        is None
+    )
+    # unlabeled series are never capped (cardinality 1 by construction)
+    metrics.inc("family_a_total")
+    assert metrics.counter_value("family_a_total") == 1.0
+    # reset clears the admission ledger too
+    metrics.reset_all_for_tests()
+    metrics.inc("family_a_total", labels={"x": "fresh"})
+    assert metrics.counter_value("family_a_total", {"x": "fresh"}) == 1.0
+    metrics.reset_all_for_tests()

@@ -171,6 +171,85 @@ def test_browser_origin_gates_loopback_privates():
     asyncio.run(run())
 
 
+async def _get(port, path, headers=None):
+    """Raw-socket GET returning (status, body bytes)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    writer.write(
+        f"GET {path} HTTP/1.1\r\n{extra}Connection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split(b" ", 2)[1]), body
+
+
+def test_api_key_gates_metrics_but_not_healthz():
+    """The api key gates everything INCLUDING the metrics scrape; /healthz
+    is the one documented exception (liveness probes run before secrets
+    are provisioned)."""
+
+    async def run():
+        srv = JsonRpcServer("127.0.0.1", 0, api_key="sekrit")
+        await srv.start()
+        try:
+            status, _ = await _get(srv.port, "/metrics")
+            assert status == 403
+            status, _ = await _get(
+                srv.port, "/metrics", {"x-api-key": "wrong"}
+            )
+            assert status == 403
+            status, body = await _get(
+                srv.port, "/metrics", {"x-api-key": "sekrit"}
+            )
+            assert status == 200 and b"# TYPE" in body
+            # /healthz: keyless GET answers (no provider -> liveness-only)
+            for path in ("/healthz", "/healthz/", "/healthz?probe=1"):
+                status, body = await _get(srv.port, path)
+                assert status == 200, path
+                assert json.loads(body)["status"] == "ok"
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_healthz_serves_provider_verdict():
+    async def run():
+        srv = JsonRpcServer("127.0.0.1", 0, api_key="sekrit")
+        verdict = {"status": "ok", "height": 7}
+        srv.health_fn = lambda: verdict
+        await srv.start()
+        try:
+            status, body = await _get(srv.port, "/healthz")
+            assert status == 200 and json.loads(body)["height"] == 7
+            # degraded is still HTTP 200: the node is alive and serving,
+            # only "stalled" should make an orchestrator restart it
+            verdict = {"status": "degraded", "height": 7}
+            srv.health_fn = lambda: verdict
+            status, body = await _get(srv.port, "/healthz")
+            assert status == 200
+            assert json.loads(body)["status"] == "degraded"
+            verdict = {"status": "stalled", "height": 7}
+            srv.health_fn = lambda: verdict
+            status, body = await _get(srv.port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "stalled"
+            # a crashing provider reads as stalled, not a 500 traceback
+            def boom():
+                raise RuntimeError("no")
+
+            srv.health_fn = boom
+            status, body = await _get(srv.port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["status"] == "stalled"
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+
+
 def test_signature_replay_rejected():
     """One-shot signatures: the same (signature, timestamp) pair must not
     authorize twice — replaying a captured wallet-spending request would

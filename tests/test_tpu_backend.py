@@ -211,9 +211,9 @@ def test_era_batch_records_pad_waste_and_route_metrics(tpu_backend):
     )
     assert all(ok for ok, _ in out)
     # 3 slots pad to S_pad=4: one dummy slot, waste 0.25
-    assert metrics.counter_value("crypto_tpu_era_slots_padded") == 1
+    assert metrics.counter_value("crypto_tpu_era_slots_padded_total") == 1
     assert (
-        metrics.counter_value("crypto_tpu_era_route", labels={"path": "host"})
+        metrics.counter_value("crypto_tpu_era_route_total", labels={"path": "host"})
         == 1
     )
     waste = metrics.histogram_snapshot("crypto_tpu_era_pad_waste")
@@ -257,7 +257,7 @@ def test_kernel_cache_hit_miss_counters(tmp_path, monkeypatch):
     arg = np.zeros((2, 2), dtype=np.int32)
     assert kc.call(FakeJit(), "fake_kernel", arg) == "ran"
     tiers = lambda t: metrics.counter_value(  # noqa: E731
-        "kernel_cache_requests", labels={"tier": t}
+        "kernel_cache_requests_total", labels={"tier": t}
     )
     assert tiers("compile") == 1
     assert tiers("memo") == 0
@@ -273,12 +273,12 @@ def test_kernel_cache_hit_miss_counters(tmp_path, monkeypatch):
     # warm() counters share the tier scheme
     assert kc.warm(FakeJit(), "fake_kernel", arg) is True
     assert (
-        metrics.counter_value("kernel_cache_warm", labels={"tier": "memo"})
+        metrics.counter_value("kernel_cache_warm_total", labels={"tier": "memo"})
         == 1
     )
     assert kc.warm(FakeJit(), "other_kernel", arg) is False
     assert (
-        metrics.counter_value("kernel_cache_warm", labels={"tier": "compile"})
+        metrics.counter_value("kernel_cache_warm_total", labels={"tier": "compile"})
         == 1
     )
 

@@ -83,10 +83,10 @@ def test_mesh_warm_cache_zero_compile_events(tmp_path, monkeypatch):
     # second warmup must go through the persistent on-disk cache
     kernel_cache._memo.clear()
     compiles_before = metrics.counter_value(
-        "kernel_cache_requests", labels={"tier": "compile"}
+        "kernel_cache_requests_total", labels={"tier": "compile"}
     )
     disk_before = metrics.counter_value(
-        "kernel_cache_requests", labels={"tier": "disk"}
+        "kernel_cache_requests_total", labels={"tier": "disk"}
     )
 
     backend2 = TpuBackend(min_device_lanes=1)
@@ -97,10 +97,10 @@ def test_mesh_warm_cache_zero_compile_events(tmp_path, monkeypatch):
     assert backend2.era_calls == backend.era_calls
 
     compiles_after = metrics.counter_value(
-        "kernel_cache_requests", labels={"tier": "compile"}
+        "kernel_cache_requests_total", labels={"tier": "compile"}
     )
     disk_after = metrics.counter_value(
-        "kernel_cache_requests", labels={"tier": "disk"}
+        "kernel_cache_requests_total", labels={"tier": "disk"}
     )
     assert compiles_after == compiles_before, (
         "warm cache must not compile"

@@ -1,0 +1,142 @@
+"""Trace-context wire trailer: emission rules, O(1) parsing, mixed-version
+compatibility (a pre-trailer decoder must accept trailer-bearing frames and
+vice versa), signature coverage, and receiver-side era->trace-id tracking."""
+import random
+import zlib
+
+import pytest
+
+from lachain_tpu.consensus import messages as M
+from lachain_tpu.crypto import ecdsa
+from lachain_tpu.network import wire
+from lachain_tpu.network.manager import NetworkManager
+from lachain_tpu.utils import tracing
+
+pytestmark = pytest.mark.observability
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+def _ready(era: int) -> wire.NetworkMessage:
+    return wire.consensus_msg(
+        era,
+        M.ReadyMessage(
+            rbc=M.ReliableBroadcastId(era=era, sender_id=0), root=b"\x55" * 32
+        ),
+    )
+
+
+def _factory(seed=1) -> wire.MessageFactory:
+    return wire.MessageFactory(ecdsa.generate_private_key(Rng(seed)))
+
+
+# A faithful copy of the PRE-TRAILER messages() decoder (plain
+# zlib.decompress + strict EOF on the decompressed payload). The compat
+# claim this file makes is exactly "the old decoder accepts new frames":
+# keep this in sync with what shipped before the trailer existed.
+def _legacy_messages(batch: wire.MessageBatch):
+    d = zlib.decompressobj()
+    raw = d.decompress(batch.content, 1 << 26)
+    if d.unconsumed_tail or not d.eof:
+        raise ValueError("batch too large")
+    r = wire.Reader(raw)
+    out = []
+    for _ in range(r.u32()):
+        out.append(wire.NetworkMessage.decode_from(r))
+    r.assert_eof()
+    return out
+
+
+def test_consensus_batch_carries_trailer():
+    f = _factory()
+    batch = f.batch([_ready(5), wire.ping_request(3)])
+    ctx = batch.trace_trailer()
+    assert ctx is not None
+    origin, era, tid = ctx
+    assert origin == wire.node_trace_origin(f.public_key)
+    assert era == 5
+    assert tid == wire.era_trace_id(f.public_key, 5)
+    assert batch.verify()
+
+
+def test_trailer_era_is_newest_in_mixed_batch():
+    f = _factory()
+    batch = f.batch([_ready(4), _ready(7), _ready(6)])
+    assert batch.trace_trailer()[1] == 7
+
+
+def test_no_trailer_without_consensus_messages():
+    f = _factory()
+    batch = f.batch([wire.ping_request(1), wire.ping_reply(2)])
+    assert batch.trace_trailer() is None
+    assert batch.verify()
+
+
+def test_pre_trailer_sender_yields_no_trailer():
+    f = _factory()
+    f.trace_trailer = False  # models a pre-trailer build's sender
+    batch = f.batch([_ready(5)])
+    assert batch.trace_trailer() is None
+    assert batch.verify()
+    # and the modern decoder accepts the old frame unchanged
+    msgs = batch.messages()
+    assert [m.kind for m in msgs] == [wire.KIND_CONSENSUS]
+
+
+def test_legacy_decoder_accepts_trailer_frames():
+    f = _factory()
+    batch = f.batch([_ready(5), wire.ping_request(9)])
+    assert batch.trace_trailer() is not None
+    old = _legacy_messages(batch)
+    new = batch.messages()
+    assert old == new
+    assert wire.parse_consensus(old[0])[0] == 5
+
+
+def test_trailer_is_signature_covered():
+    f = _factory()
+    batch = f.batch([_ready(5)])
+    assert batch.verify()
+    c = bytearray(batch.content)
+    c[-1] ^= 0x01  # flip a bit inside the trailer's trace id
+    forged = wire.MessageBatch(batch.sender, batch.signature, bytes(c))
+    assert not forged.verify()
+
+
+def test_batch_roundtrip_preserves_trailer():
+    f = _factory()
+    encoded = f.batch([_ready(11)]).encode()
+    back = wire.MessageBatch.decode(encoded)
+    assert back.verify()
+    assert back.trace_trailer()[1] == 11
+
+
+def test_receiver_tracks_era_trace_ids(monkeypatch):
+    tracing.reset_for_tests()
+    nm = NetworkManager(ecdsa.generate_private_key(Rng(1)))
+    a, b = _factory(2), _factory(3)
+    nm._note_trace_ctx(a.batch([_ready(5)]))
+    nm._note_trace_ctx(a.batch([_ready(5)]))  # repeat: set probe only
+    nm._note_trace_ctx(b.batch([_ready(5)]))
+    nm._note_trace_ctx(b.batch([wire.ping_request(1)]))  # no trailer: ignored
+    want = sorted(
+        wire.era_trace_id(f.public_key, 5).hex() for f in (a, b)
+    )
+    assert nm.trace_ids_for(5) == want
+    assert nm.trace_ids_for(6) == []
+    # first sighting per (era, id) emits exactly one wire.trace_ctx instant
+    instants = [d for d in tracing.snapshot() if d["name"] == "wire.trace_ctx"]
+    assert len(instants) == 2
+    assert sorted(d["args"]["trace"] for d in instants) == want
+    # era retention is bounded: old eras evicted once KEEP is exceeded
+    for era in range(10, 10 + nm._TRACE_ERA_KEEP + 2):
+        nm._note_trace_ctx(a.batch([_ready(era)]))
+    assert len(nm.era_trace_ids) == nm._TRACE_ERA_KEEP
+    assert 5 not in nm.era_trace_ids
+    tracing.reset_for_tests()

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Repo-invariant linter: static checks for the guarantees the tests assume.
 
-Three rule families over `lachain_tpu/` (AST-based, zero dependencies):
+Four rule families over `lachain_tpu/` (AST-based, zero dependencies):
 
 D. **Determinism** — the consensus modules (`consensus/`,
    `core/parallel_exec.py`, `storage/trie.py`) must replay bit-identically:
@@ -35,6 +35,13 @@ P. **Persist-before-transmit** — in `consensus/`, a raw transport send
    call appears on an earlier line of the same function body". Functions
    that REPLAY already-journaled bytes are whitelisted below, with the
    reason recorded next to the name.
+
+M. **Metric-name hygiene** — counters and histograms minted through
+   `utils.metrics` (`inc` / `observe_hist` / `histogram`) must end in
+   `_total`, `_seconds` or `_bytes`; point-in-time gauges go through
+   `set_gauge` and carry no suffix by convention. Untyped names rot
+   dashboards: a scraper cannot tell a monotonic counter from a
+   distribution, and rate() over a gauge-shaped name is silently wrong.
 
 Escape hatch: a line ending in `# lint-allow: <rule-id> <reason>` silences
 that line for that rule. Allowed lines are counted and printed so silent
@@ -627,6 +634,60 @@ def check_persist_before_transmit(
     return out
 
 
+# -- rule M: metric-name hygiene ---------------------------------------------
+
+METRIC_SUFFIXES = ("_total", "_seconds", "_bytes")
+# counters and histograms minted through these utils.metrics entry points
+# must carry a typed unit suffix so the exposition stays greppable and a
+# dashboard can tell a monotonic counter from a distribution by name
+# alone. Gauges (set_gauge) are the documented exception: registration IS
+# the gauge convention, point-in-time values carry no unit suffix.
+METRIC_NAME_CALLS = ("inc", "observe_hist", "histogram")
+
+
+def check_metric_names(
+    relpath: str, tree: ast.Module, src_lines: List[str]
+) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr not in METRIC_NAME_CALLS:
+            continue
+        base = _dotted(node.func.value)
+        # only the utils.metrics module object counts (imported as
+        # `metrics` or aliased `_metrics`); foo.inc() on anything else is
+        # not a metric mint
+        if base is None or base.split(".")[-1] not in (
+            "metrics", "_metrics"
+        ):
+            continue
+        args = node.args
+        name_node = args[0] if args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_node = kw.value
+        if not isinstance(name_node, ast.Constant) or not isinstance(
+            name_node.value, str
+        ):
+            continue  # dynamic names are reviewed by humans
+        mname = name_node.value
+        if mname.endswith(METRIC_SUFFIXES):
+            continue
+        if _line_allowed(src_lines, node.lineno, "metric-name"):
+            continue
+        kind = "counter" if node.func.attr == "inc" else "histogram"
+        out.append(Violation(
+            relpath, node.lineno, "metric-name",
+            f"{kind} {mname!r} lacks a typed suffix "
+            f"({'/'.join(METRIC_SUFFIXES)}); gauges belong in "
+            "set_gauge()",
+        ))
+    return out
+
+
 # -- driver ------------------------------------------------------------------
 
 
@@ -681,6 +742,10 @@ def run(root: str) -> int:
             violations += check_persist_before_transmit(
                 relpath, tree, src_lines
             )
+        if rel_in_pkg != "utils/metrics.py":
+            # the registry's own plumbing (render_text's fold cell, the
+            # drop counter) is not a mint site
+            violations += check_metric_names(relpath, tree, src_lines)
         lock_checker.analyze(relpath, tree, src_lines)
 
     lock_checker.build_edges()
