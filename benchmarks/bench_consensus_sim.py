@@ -226,6 +226,14 @@ def main() -> None:
             "wall_s": ent["wall_s"],
             **ent["phases_s"],
             "idle_s": ent["idle_s"],
+            # idle decomposition: named wait buckets + the remainder the
+            # recorder could not attribute (compare.py gates the fraction
+            # so idle can never go opaque again)
+            "waits_s": ent.get("waits_s", {}),
+            "idle_unattributed_s": ent.get("idle_unattributed_s", 0.0),
+            "idle_unattributed_fraction": ent.get(
+                "idle_unattributed_fraction", 0.0
+            ),
             # wall time shared with other in-flight eras (era pipelining);
             # 0.0 everywhere in a sequential run
             "overlap_s": ent.get("overlap_s", 0.0),

@@ -178,6 +178,26 @@ def compare(base: dict, cur: dict, floor: float) -> Tuple[int, str]:
             f"(allowed {allowed:.1f}%) "
             f"{'REGRESSION' if regressed else 'ok'}"
         )
+        # idle-opacity gate (ISSUE 16): the fraction of idle the recorder
+        # could NOT attribute to a named wait bucket must not creep back
+        # up. Fractions sit near zero, so a percent-relative check would
+        # be all noise — gate on an absolute slack over the baseline
+        # instead (the percent threshold re-used as percentage points).
+        try:
+            bfrac = float(bper[era]["idle_unattributed_fraction"])
+            cfrac = float(cper[era]["idle_unattributed_fraction"])
+        except (TypeError, ValueError, KeyError):
+            continue  # pre-16 baseline: nothing to hold the line against
+        slack = max(0.10, allowed / 100.0)
+        frac_bad = cfrac > bfrac + slack
+        failed = failed or frac_bad
+        field = f"era[{era}].idle_unattr_frac"
+        rows.append(
+            f"  {field:<32} {bfrac:>12.4f} -> {cfrac:>12.4f}  "
+            f"{(cfrac - bfrac) * 100.0:+7.1f}pp worse "
+            f"(allowed {slack * 100.0:.1f}pp) "
+            f"{'REGRESSION' if frac_bad else 'ok'}"
+        )
     verdict = "REGRESSION" if failed else "PASS"
     header = (
         f"{verdict}: {base['metric']} vs baseline "

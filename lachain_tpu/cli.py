@@ -220,6 +220,10 @@ def _build_node(cfg, config_path=None):
         exec_lanes=cfg.execution_lanes,
         merkle_workers=cfg.merkle_workers,
     )
+    if cfg.idle_alert_fraction is not None:
+        # observability.idleAlertFraction: /healthz reads degraded when
+        # the rolling era idle fraction exceeds this
+        node.idle_alert_fraction = float(cfg.idle_alert_fraction)
     peers = []
     for spec in cfg.network.peers:
         host, port, pubhex = spec.rsplit(":", 2)
@@ -456,7 +460,7 @@ def cmd_trace(args) -> int:
     Chrome trace_event JSON — load it in chrome://tracing or Perfetto."""
     import urllib.request
 
-    if args.era_report:
+    if args.era_report or args.critical_path:
         method = "la_getEraReport"
     elif args.summary:
         method = "la_getTraceSummary"
@@ -464,7 +468,8 @@ def cmd_trace(args) -> int:
         method = "la_getTrace"
     params = (
         []
-        if args.summary or args.era_report or args.limit is None
+        if args.summary or args.era_report or args.critical_path
+        or args.limit is None
         else [args.limit]
     )
     body = json.dumps(
@@ -480,10 +485,13 @@ def cmd_trace(args) -> int:
               file=sys.stderr)
         return 1
     result = out["result"]
-    if args.era_report:
+    if args.era_report or args.critical_path:
         from .utils import tracing
 
-        print(tracing.era_report_table(result))
+        if args.era_report:
+            print(tracing.era_report_table(result))
+        if args.critical_path:
+            print(tracing.critical_path_table(result))
         reported = result.get("eras", [])
         if reported and args.out:
             with open(args.out, "w") as fh:
@@ -1065,7 +1073,15 @@ def main(argv=None) -> int:
         "--era-report",
         action="store_true",
         help="print the per-era phase table (propose/RBC/BA/coin/TPKE/"
-        "commit + idle) from the merged flight recorder",
+        "commit + idle split into wait buckets) from the merged flight "
+        "recorder",
+    )
+    tr.add_argument(
+        "--critical-path",
+        action="store_true",
+        help="print each era's longest blocking chain (phase and wait "
+        "segments from era start to commit) from the merged flight "
+        "recorder",
     )
     tr.set_defaults(fn=cmd_trace)
 

@@ -85,6 +85,7 @@ class TpkeEraBatcher:
         per-job (ok, combined) list, in submission order."""
         if jobs:
             self._pending.append((jobs, verification_keys, callback, era))
+            metrics.set_gauge("tpke_batcher_queue_depth", self.pending)
 
     def submit_lazy(self, build, era: Optional[int] = None) -> None:
         """Queue a job BUILDER resolved at flush time: `build()` returns
@@ -93,6 +94,7 @@ class TpkeEraBatcher:
         per-slot preparation (share parsing, Lagrange rows) exactly once per
         flush, covering everything that became ready in the meantime."""
         self._lazy.append((build, era))
+        metrics.set_gauge("tpke_batcher_queue_depth", self.pending)
 
     def flush(self, era: Optional[int] = None) -> int:
         """Run pending jobs through the backend era call; returns the number
@@ -101,6 +103,15 @@ class TpkeEraBatcher:
         inside flush and may re-submit (their work joins the NEXT flush)."""
         if not self._pending and not self._lazy:
             return 0
+        # from the dispatch loop's perspective the WHOLE flush call — lazy
+        # job build, backend dispatch, result fan-out — is one stall on the
+        # crypto subsystem: tag it for the idle decomposition. Protocol
+        # spans opened by delivery callbacks outrank the wait in the era
+        # sweep, so real work re-entered from here never double counts.
+        with tracing.wait("crypto_flush", pending=self.pending):
+            return self._flush_inner(era)
+
+    def _flush_inner(self, era: Optional[int] = None) -> int:
         from ..crypto.provider import get_backend
 
         if era is None:
@@ -231,6 +242,7 @@ class TpkeEraBatcher:
         )
         self.flushes += 1
         self.slots_flushed += len(flat_jobs)
+        metrics.set_gauge("tpke_batcher_queue_depth", self.pending)
         # regroup per submission and deliver
         per_sub: List[List] = [
             [None] * len(jobs) for (jobs, _vks, _cb) in batch

@@ -710,6 +710,23 @@ enum TraceKind : uint32_t {
   TK_POST = 3,         // a = PO_* op (coarse ops only; per-slot ops skipped)
   TK_STAGE = 4,        // a = TS_* stage code
   TK_PHASE = 5,        // a = TP_* phase, dur = accumulated dispatch ns
+  TK_WAIT = 6,         // a = WR_* resource, b = min live era; dur = the gap
+                       // the dispatch loop sat starved (queue empty between
+                       // two rt_run calls — host-side flush/IO time)
+};
+
+// Waited-on resource tags shared with the Python wait spans
+// (utils/tracing.WAIT_RESOURCES); the engine itself only ever emits
+// WR_SCHED — it cannot know WHAT the host was doing while the queue was
+// empty, only that it was starved. Higher-priority Python wait spans
+// (crypto_flush/device/fsync/net) claim their share of the same gap in
+// the era-report sweep; WR_SCHED owns the remainder.
+enum TraceWaitResource : uint32_t {
+  WR_NET = 1,
+  WR_CRYPTO_FLUSH = 2,
+  WR_DEVICE = 3,
+  WR_FSYNC = 4,
+  WR_SCHED = 5,
 };
 
 enum TraceStage : uint32_t {
@@ -801,6 +818,9 @@ struct Engine {
   // flush order is deterministic across identically-seeded runs
   std::map<uint32_t, std::array<uint64_t, 8>> phase_acc;
   uint64_t cross_ns = 0;  // crossing time inside the current deliver()
+  // queue-empty starvation tracking: set when run() exits with nothing to
+  // dispatch, resolved into one TK_WAIT record when the host pumps again
+  uint64_t idle_since_ns = 0;
 
   static inline uint32_t phase_of(const Msg* m) {
     switch (m->type) {
@@ -1039,6 +1059,18 @@ struct Engine {
     // (threshold BLS sign/verify per validator) that a prompt stop avoids.
     size_t processed = 0;
     stop_req = false;
+    if (trace.enabled && idle_since_ns) {
+      // the previous run() left the queue empty: the gap until this pump
+      // is host-side time the dispatch loop spent starved. Emitted even
+      // for a zero-width gap so the record SEQUENCE stays deterministic
+      // across identically-seeded runs (durations are wall-clock anyway).
+      int min_era = vals[0].era;
+      for (auto& v : vals) min_era = v.era < min_era ? v.era : min_era;
+      uint64_t now = trace_now_ns();
+      trace.push(idle_since_ns, now > idle_since_ns ? now - idle_since_ns : 0,
+                 TK_WAIT, 0xFFFFFFFFu, WR_SCHED, (uint32_t)min_era);
+      idle_since_ns = 0;
+    }
     while (processed < max_msgs && !q.empty() && !stop_req) {
       Entry e = pop();
       delivered++;
@@ -1061,6 +1093,7 @@ struct Engine {
       msg_release(e.m);
     }
     stop_req = false;
+    if (trace.enabled && q.empty()) idle_since_ns = trace_now_ns();
     return processed;
   }
 
@@ -2078,7 +2111,7 @@ void NRoot::maybe_verify() {
 
 extern "C" {
 
-int lt_crt_version() { return 4; }
+int lt_crt_version() { return 5; }
 
 // Engines are single-threaded by contract: one engine = one queue = one
 // dispatch loop. The pipelined era window (native_rt.py) therefore runs ONE

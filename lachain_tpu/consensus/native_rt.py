@@ -131,7 +131,7 @@ def load_rt():
             )
     lib = ctypes.CDLL(lib_path)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 4
+    assert lib.lt_crt_version() == 5
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -235,9 +235,11 @@ def load_rt():
 
 # consensus_rt.cpp trace record contract: 32-byte big-endian records
 _TRACE_RECORD = struct.Struct(">QQIIII")
-TK_ERA_ADVANCE, TK_CROSS, TK_POST, TK_STAGE, TK_PHASE = 1, 2, 3, 4, 5
+TK_ERA_ADVANCE, TK_CROSS, TK_POST, TK_STAGE, TK_PHASE, TK_WAIT = 1, 2, 3, 4, 5, 6
 # TP_* dispatch-phase buckets -> era-report phase keys (tracing._DISPATCH_PHASE)
 TP_NAMES = {1: "rbc", 2: "ba", 3: "coin", 4: "tpke", 5: "commit", 6: "other"}
+# WR_* wait resources (TK_WAIT.a) -> era-report wait buckets (tracing.WAIT_RESOURCES)
+WR_NAMES = {1: "net", 2: "crypto_flush", 3: "device", 4: "fsync", 5: "sched"}
 # the coarse PO_* ops the engine records (native_post keeps per-slot ops out)
 _PO_TRACE_NAMES = {2: "coin_result", 3: "hb_acs_input", 5: "hb_acs_done",
                    12: "root_header"}
@@ -324,6 +326,21 @@ def decode_consensus_trace(
                     tname=f"validator-{tid}",
                     args={"stage": a, "era": b, "vid": tid},
                 )
+            )
+        elif kind == TK_WAIT:
+            res = WR_NAMES.get(a, str(a))
+            evs.append(
+                dict(
+                    common,
+                    name=f"wait:{res}",
+                    cat="native.wait",
+                    tid=0,
+                    tname="dispatch",
+                    args={"resource": res, "era": b},
+                )
+            )
+            metrics.observe_hist(
+                "wait_seconds", dur / 1e9, labels={"resource": res}
             )
     return evs
 
@@ -1237,9 +1254,15 @@ class NativeSimulatedNetwork:
     # -- execution (simulator.py::run contract) --------------------------------
     def post_request(self, validator: int, pid, value) -> None:
         self._sync_ownership()
-        self.routers[validator].internal_request(
-            M.Request(from_id=None, to_id=pid, input=value)
-        )
+        # proposal injection does the RBC encode (erasure coding) before
+        # the first dispatch chunk runs — outside the engine's phase
+        # accumulators, so tag it as propose-phase work here
+        with tracing.span(
+            "consensus.propose", era=getattr(pid, "era", None)
+        ):
+            self.routers[validator].internal_request(
+                M.Request(from_id=None, to_id=pid, input=value)
+            )
 
     def run(
         self,
@@ -1252,6 +1275,10 @@ class NativeSimulatedNetwork:
                 processed = self._lib.rt_run(self._h, chunk)
                 self.delivered_count += processed
                 self._raise_cb_error()
+                metrics.set_gauge(
+                    "consensus_dispatch_queue_depth",
+                    self._lib.rt_queue_len(self._h),
+                )
                 if (
                     self.crypto_batcher is not None
                     and self.crypto_batcher.pending
@@ -1360,6 +1387,9 @@ class NativeSimulatedNetwork:
             delivered += processed
             self.delivered_count += processed
             self._raise_cb_error(era)
+            metrics.set_gauge(
+                "consensus_dispatch_queue_depth", self._lib.rt_queue_len(h)
+            )
             if (
                 self.crypto_batcher is not None
                 and self.crypto_batcher.pending_for(era)

@@ -28,6 +28,7 @@ import random
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from ..utils import metrics, tracing
 from . import messages as M
 from .era import EraRouter
 from .keys import PrivateConsensusKeys, PublicConsensusKeys
@@ -168,11 +169,18 @@ class SimulatedNetwork:
         batcher = self.crypto_batcher
         while not done():
             if not self._queue:
+                metrics.set_gauge("consensus_dispatch_queue_depth", 0)
                 if batcher is not None and batcher.pending:
                     batcher.flush()
                     continue
-                if self.faults is not None and self._recover():
-                    continue
+                if self.faults is not None:
+                    # outbox replay is the in-process stand-in for the
+                    # message_request wire exchange: waiting on it is a
+                    # network receive wait
+                    with tracing.wait("net", kind="recover"):
+                        recovered = self._recover()
+                    if recovered:
+                        continue
                 return done()
             if self.delivered_count >= max_messages:
                 raise RuntimeError(

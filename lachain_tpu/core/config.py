@@ -287,6 +287,16 @@ class NodeConfig:
         shift = self.raw.get("observability", {}).get("txSampleShift")
         return None if shift is None else int(shift)
 
+    @property
+    def idle_alert_fraction(self) -> Optional[float]:
+        """Idle-anatomy health alert (observability.idleAlertFraction):
+        when the rolling era idle fraction from the flight recorder
+        exceeds this value, /healthz reads degraded with an idle-fraction
+        reason. Optional and additive (no config version bump): absent
+        disables the alert."""
+        frac = self.raw.get("observability", {}).get("idleAlertFraction")
+        return None if frac is None else float(frac)
+
     @classmethod
     def from_dict(cls, cfg: dict) -> "NodeConfig":
         cfg = migrate(cfg)

@@ -163,6 +163,9 @@ class Node:
         self._last_commit_mono = time.monotonic()
         self._last_commit_wall = time.time()
         self._stall_stage = 0
+        # idle-anatomy alert (observability.idleAlertFraction): when set,
+        # a rolling era idle fraction above it reads degraded on /healthz
+        self.idle_alert_fraction: Optional[float] = None
         self.validator_manager = ValidatorManager(self.state, public_keys)
         from .fast_sync import FastSynchronizer
 
@@ -479,7 +482,9 @@ class Node:
 
         ok       — committing, peered, no watchdog strikes
         degraded — behind the fleet's median height, peerless, tip older
-                   than stall_timeout, or one stall strike
+                   than stall_timeout, one stall strike, or (when
+                   idle_alert_fraction is configured) the rolling era
+                   idle fraction from the flight recorder above it
         stalled  — watchdog escalated (strike >= 2, python or native) or
                    no commit for 2x stall_timeout
         """
@@ -495,12 +500,31 @@ class Node:
         # peerless is only a symptom when peers are EXPECTED: a
         # single-validator devnet with nobody to dial stays "ok"
         expected_peers = max(0, len(self._pub_by_index) - 1)
+        # rolling idle fraction over the last few completed eras in the
+        # flight recorder; only computed when the alert is configured
+        # (era_report sweeps the span ring — cheap, but not free)
+        idle_fraction = None
+        idle_alerting = False
+        if self.idle_alert_fraction is not None:
+            try:
+                from ..utils import tracing
+
+                eras = tracing.era_report()["eras"][-3:]
+                walls = sum(e["wall_s"] for e in eras)
+                if walls > 0:
+                    idle_fraction = round(
+                        sum(e["idle_s"] for e in eras) / walls, 4
+                    )
+                    idle_alerting = idle_fraction > self.idle_alert_fraction
+            except Exception:
+                pass  # a recorder hiccup must never break the probe
         verdict = "ok"
         if (
             lag > 5
             or tip_age > self.stall_timeout
             or (expected_peers > 0 and not self.network.peers)
             or strikes == 1
+            or idle_alerting
         ):
             verdict = "degraded"
         if strikes >= 2 or tip_age > 2 * self.stall_timeout:
@@ -516,6 +540,7 @@ class Node:
             "medianPeerHeight": median_peer,
             "commitLagVsPeers": lag,
             "stallStrikes": strikes,
+            "idleFraction": idle_fraction,
         }
 
     async def start_rpc(

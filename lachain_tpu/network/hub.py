@@ -18,7 +18,7 @@ import logging
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -119,7 +119,10 @@ class Hub:
         """Shared frame loop for both directions (batches are
         connection-agnostic; identity lives in the batch signature)."""
         while True:
-            header = await reader.readexactly(4)
+            # the inter-frame gap IS this node's network receive wait:
+            # tag it so the era report's idle decomposition can claim it
+            with tracing.wait("net", conn=conn_id):
+                header = await reader.readexactly(4)
             n = int.from_bytes(header, "big")
             if n > MAX_FRAME:
                 raise ValueError("oversized frame")

@@ -461,7 +461,8 @@ class MeshEraPipeline:
         self.calls += 1
 
         def finish():
-            jax.block_until_ready((pts, flags))
+            with tracing.wait("device", devices=self.n_devices):
+                jax.block_until_ready((pts, flags))
             busy = metrics.monotonic() - t_dispatch
             tracing.end(sid)
             self.device_busy_s += busy

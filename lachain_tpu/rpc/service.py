@@ -625,8 +625,12 @@ class RpcService:
     def la_getEraReport(self):
         """Per-era phase attribution (propose/RBC/BA/coin/TPKE-verify/
         TPKE-decrypt/commit + idle), merged from the Python span ring and
-        the native engines' flight-recorder rings. The input for deciding
-        what to overlap when pipelining eras."""
+        the native engines' flight-recorder rings. Each era's idle column
+        is decomposed into named wait buckets (waits_s: net/crypto_flush/
+        device/fsync/sched, from wait spans and native wait records) plus
+        an idle_unattributed remainder, and carries a critical_path block
+        — the longest blocking chain from era start to commit. The input
+        for deciding what to overlap when pipelining eras."""
         from ..utils import tracing
 
         return tracing.era_report()

@@ -19,6 +19,8 @@ import sqlite3
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..utils import tracing
+
 
 class EntryPrefix(enum.IntEnum):
     """2-byte keyspace partition (reference EntryPrefix.cs)."""
@@ -215,7 +217,8 @@ class SqliteKV(KVStore):
                 # mid = after the writes, before the fsynced commit: the
                 # window a kill -9 must roll back entirely
                 crash_point("kv.write_batch.mid")
-                self._conn.commit()
+                with tracing.wait("fsync"):
+                    self._conn.commit()
             except BaseException:
                 # a half-written batch must NOT linger in the open implicit
                 # transaction, or the next unrelated put() would commit it

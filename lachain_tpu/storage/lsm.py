@@ -69,6 +69,7 @@ _LK_NAMES = {
     22: "memtable_seal",  # a = bytes, b = new WAL segment
     23: "memtable_flush",  # a = bytes, b = sst seq
     24: "compaction",  # a = input tables, b = output seq
+    25: "wait:fsync",  # caller blocked on durability; a = wait resource
 }
 _LT_NAMES = {0: "caller", 1: "wal-writer", 2: "flusher", 3: "compactor"}
 # bytes-per-group-commit spread widely; record counts are small integers
@@ -150,7 +151,7 @@ def _load_lib():
     lib.lsm_table_count.restype = ctypes.c_uint64
     lib.lsm_table_count.argtypes = [ctypes.c_void_p]
     lib.lsm_version.restype = ctypes.c_int
-    assert lib.lsm_version() == 5
+    assert lib.lsm_version() == 6
     lib.lsm_monotonic_ns.restype = ctypes.c_uint64
     lib.lsm_monotonic_ns.argtypes = []
     lib.lsm_trace_configure.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -233,19 +234,27 @@ class LsmKV(KVStore):
         for i in range(0, len(raw) - (len(raw) % 32), 32):
             ts, dur, kind, tid, a, b = _TRACE_RECORD.unpack_from(raw, i)
             name = _LK_NAMES.get(kind, str(kind))
+            is_wait = kind == 25  # LK_WAIT: caller-side durability stall
             evs.append(
                 {
                     "name": name,
-                    "cat": "native.lsm",
+                    "cat": "native.wait" if is_wait else "native.lsm",
                     "start": ts / 1e9 + self._trace_offset,
                     "end": (ts + dur) / 1e9 + self._trace_offset,
                     "pid": self._trace_pid,
                     "pname": self._trace_source.rsplit("-", 1)[0],
                     "tid": tid,
                     "tname": _LT_NAMES.get(tid, str(tid)),
-                    "args": {"a": a, "b": b},
+                    "args": {"resource": "fsync"} if is_wait
+                    else {"a": a, "b": b},
                 }
             )
+            if is_wait:
+                from ..utils import metrics
+
+                metrics.observe_hist(
+                    "wait_seconds", dur / 1e9, labels={"resource": "fsync"}
+                )
             if kind == 21:  # LK_WAL_FSYNC: the never-published v2 numbers
                 from ..utils import metrics
 

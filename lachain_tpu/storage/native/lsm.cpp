@@ -764,6 +764,7 @@ enum LsmTraceKind : u32 {
   LK_SEAL = 22,       // instant: memtable sealed; a=bytes, b=new segment
   LK_FLUSH = 23,      // span: memtable -> SST; a=bytes, b=sst seq
   LK_COMPACT = 24,    // span: full merge; a=input tables, b=output seq
+  LK_WAIT = 25,       // span: caller blocked; a=wait resource (4=fsync)
 };
 
 enum LsmTraceTid : u32 {
@@ -1097,7 +1098,14 @@ struct Lsm {
   // entry — this is the post-apply ack wait.
   bool wal_wait(u64 seq) {
     std::unique_lock<std::mutex> lk(wal_mu);
+    if (wal_error || wal_durable >= seq) return !wal_error;
+    // the caller genuinely blocks on durability: record the wait so the
+    // era report can attribute it to the fsync bucket
+    bool timed = trace.enabled.load(std::memory_order_relaxed);
+    u64 t0 = timed ? trace_now_ns() : 0;
     wal_done.wait(lk, [&] { return wal_error || wal_durable >= seq; });
+    if (timed)
+      trace.push(t0, trace_now_ns() - t0, LK_WAIT, LT_CALLER, 4, 0);
     return !wal_error;
   }
 
@@ -1895,6 +1903,6 @@ u64 lsm_trace_drain(void* h, u8* buf, u64 cap) {
   return out.size();
 }
 
-int lsm_version() { return 5; }
+int lsm_version() { return 6; }
 
 }  // extern "C"

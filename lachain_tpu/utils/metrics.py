@@ -266,6 +266,19 @@ def counters_with_prefix(prefix: str) -> Dict[Tuple[str, tuple], float]:
 #   lsm_cache_hit_ratio  block-cache hits / (hits + misses), 0.0 when cold
 #   lsm_table_count      live SSTables, lsm_compactions_total merges done
 
+# Wait-state surfaces (ISSUE 16 idle anatomy):
+#   wait_seconds{resource}          histogram of blocking waits, one series
+#                                   per resource bucket (net / crypto_flush /
+#                                   device / fsync / sched) — fed by
+#                                   tracing.wait() and the native wait
+#                                   records; the scrapeable twin of the era
+#                                   report's idle decomposition
+#   tpke_batcher_queue_depth        submissions queued in the TPKE crypto
+#                                   flush batcher (consensus/crypto_batcher)
+#   consensus_dispatch_queue_depth  undelivered messages in the dispatch
+#                                   queue (native engine or simulator) at
+#                                   the last pump iteration; 0 = starved
+
 
 def observe(name: str, seconds: float) -> None:
     with _lock:

@@ -145,6 +145,47 @@ def span(name: str, cat: str = "era", **args):
         end(sid)
 
 
+# The named wait buckets era_report() decomposes idle into. Every blocking
+# point in an era thread tags itself with the resource it waits on; the
+# remainder (time nothing claims) is reported as idle_unattributed.
+WAIT_RESOURCES = ("net", "crypto_flush", "device", "fsync", "sched")
+# Overlap precedence between wait intervals: specific resources outrank
+# the broad ones. `net` is the catch-all (the hub read loop waits for
+# nearly all wall time) so it only owns segments nothing else claims;
+# `sched` (the native dispatch loop's queue-empty gap) brackets whatever
+# host-side work starved it, so the specific cause wins when present.
+_WAIT_PRIORITY = {
+    "device": 0,
+    "fsync": 1,
+    "crypto_flush": 2,
+    "sched": 3,
+    "net": 4,
+}
+
+
+@contextmanager
+def wait(resource: str, **args):
+    """Scoped wait-state span: wraps a blocking call (queue get, fsync,
+    device sync, socket read) so era_report() can attribute the idle it
+    causes to `resource`. Also feeds the wait_seconds{resource} histogram."""
+    sid = begin(f"wait.{resource}", cat="wait", resource=resource, **args)
+    t0 = time.monotonic()
+    try:
+        yield sid
+    finally:
+        end(sid)
+        try:
+            from . import metrics
+
+            metrics.observe_hist(
+                "wait_seconds",
+                time.monotonic() - t0,
+                labels={"resource": resource},
+            )
+        except Exception:  # metrics must never break the waiter
+            pass
+
+
 def open_spans() -> List[dict]:
     """Snapshot of currently-open spans, oldest first (the watchdog's
     view of what the node is stuck inside)."""
@@ -440,6 +481,7 @@ _PHASE_PRIORITY = {
 # CommonSubset, RootProtocol) are deliberately absent: their time is the
 # sum of their children plus idle, so attributing them would double count.
 _SPAN_PHASE = {
+    "consensus.propose": "propose",
     "ReliableBroadcast": "rbc",
     "BinaryAgreement": "ba",
     "BinaryBroadcast": "ba",
@@ -503,6 +545,123 @@ def _sweep(intervals: List[tuple], lo: float, hi: float) -> Dict[str, float]:
     return out
 
 
+def _sweep_waits(
+    phase_iv: List[tuple],
+    wait_iv: List[tuple],
+    lo: float,
+    hi: float,
+) -> Dict[str, float]:
+    """Exclusive per-resource wait time on the stretches of [lo, hi] that
+    NO phase interval covers: any attributed phase time outranks every
+    wait (a wait span bracketing real work must not double count), and
+    overlapping waits resolve by _WAIT_PRIORITY."""
+    edges = {lo, hi}
+    phases = []
+    for _, s, e in phase_iv:
+        s, e = max(s, lo), min(e, hi)
+        if e > s:
+            phases.append((s, e))
+            edges.add(s)
+            edges.add(e)
+    waits = []
+    for res, s, e in wait_iv:
+        s, e = max(s, lo), min(e, hi)
+        if e > s:
+            waits.append((res, s, e))
+            edges.add(s)
+            edges.add(e)
+    cuts = sorted(edges)
+    out = {r: 0.0 for r in WAIT_RESOURCES}
+    for i in range(len(cuts) - 1):
+        s, e = cuts[i], cuts[i + 1]
+        if any(ps <= s and pe >= e for ps, pe in phases):
+            continue
+        best = None
+        for res, ws, we in waits:
+            if ws <= s and we >= e:
+                pr = _WAIT_PRIORITY.get(res, len(_WAIT_PRIORITY))
+                if best is None or pr < best[0]:
+                    best = (pr, res)
+        if best is not None:
+            out.setdefault(best[1], 0.0)
+            out[best[1]] += e - s
+    return out
+
+
+def _critical_path(intervals: List[tuple], lo: float, hi: float) -> dict:
+    """Longest blocking chain through one era window.
+
+    `intervals` are (kind, name, start, end) with kind in
+    {"phase", "wait"}. Walk BACKWARDS from the era end (the commit): at
+    each cursor pick the covering interval that reaches furthest back and
+    emit one segment per hop; stretches nothing covers become
+    "gap"/"unattributed" segments (native dispatch accumulators have no
+    intervals, so engine dispatch time lands here, bounded by crossings
+    and wait records on either side). By construction the segments tile
+    [lo, hi], so their lengths sum to the era wall."""
+    eps = 1e-9
+    iv = [
+        (kind, name, max(s, lo), min(e, hi))
+        for kind, name, s, e in intervals
+        if min(e, hi) > max(s, lo)
+    ]
+    segs: List[dict] = []
+    cursor = hi
+    while cursor - lo > eps:
+        best = None
+        for kind, name, s, e in iv:
+            if s < cursor - eps and e >= cursor - eps:
+                if best is None or s < best[2]:
+                    best = (kind, name, s)
+        if best is not None:
+            start = max(best[2], lo)
+            segs.append(
+                {"kind": best[0], "name": best[1],
+                 "start": start, "end": cursor}
+            )
+            cursor = start
+        else:
+            prev = lo
+            for _, _, s, e in iv:
+                if e < cursor - eps and e > prev:
+                    prev = e
+            segs.append(
+                {"kind": "gap", "name": "unattributed",
+                 "start": prev, "end": cursor}
+            )
+            cursor = prev
+    segs.reverse()
+    merged: List[dict] = []
+    for sg in segs:
+        if (
+            merged
+            and merged[-1]["kind"] == sg["kind"]
+            and merged[-1]["name"] == sg["name"]
+        ):
+            merged[-1]["end"] = sg["end"]
+        else:
+            merged.append(dict(sg))
+    out_segs = [
+        {
+            "kind": sg["kind"],
+            "name": sg["name"],
+            "start_s": round(sg["start"] - lo, 6),
+            "end_s": round(sg["end"] - lo, 6),
+            "dur_s": round(sg["end"] - sg["start"], 6),
+        }
+        for sg in merged
+    ]
+    top = sorted(out_segs, key=lambda s: -s["dur_s"])[:5]
+    return {
+        "total_s": round(sum(s["dur_s"] for s in out_segs), 6),
+        "segments": out_segs,
+        "top": [
+            {"kind": s["kind"], "name": s["name"], "dur_s": s["dur_s"]}
+            for s in top
+        ],
+    }
+
+
 def era_report(
     spans: Optional[List[dict]] = None,
     native: Optional[List[dict]] = None,
@@ -512,7 +671,12 @@ def era_report(
     Combines three sources: Python protocol/crypto spans (interval sweep
     with nesting priority), native crossing events (batched crypto ops,
     from the drained consensus ring), and the engine's per-era exclusive
-    dispatch accumulators. Idle = wall − attributed, clamped at 0. The
+    dispatch accumulators. Idle = wall − attributed, clamped at 0, then
+    DECOMPOSED into named wait buckets (waits_s, from wait.* spans and
+    native wait records) plus an idle_unattributed remainder — the
+    invariant is buckets + remainder == the old idle value. Each era also
+    carries a critical_path block: the longest blocking chain walked
+    backwards from the era's end, whose segments tile the era wall. The
     direct input for deciding what to overlap when pipelining eras
     (ROADMAP item 1)."""
     if spans is None:
@@ -546,8 +710,22 @@ def era_report(
         if d["name"] == "mesh.device" and d["end"] is not None
     ]
 
+    # wait-state intervals (Python wait.* spans + native wait records):
+    # attributed to eras by time overlap — a hub read wait or an LSM
+    # fsync wait serves the node, not one era, so clipping is the honest
+    # split (same rule as mesh.device above)
+    wait_iv_all: List[tuple] = []
+    for d in spans:
+        if d["cat"] == "wait" and d["end"] is not None:
+            res = d["args"].get("resource") or "net"
+            wait_iv_all.append((res, d["start"], d["end"]))
+
     dispatch: Dict[int, Dict[str, float]] = {}
     for ev in native:
+        if ev.get("cat") == "native.wait":
+            res = (ev.get("args") or {}).get("resource") or "sched"
+            wait_iv_all.append((res, ev["start"], ev["end"]))
+            continue
         era = (ev.get("args") or {}).get("era")
         if era is None or int(era) not in windows:
             continue
@@ -595,6 +773,28 @@ def era_report(
             phases[phase] += secs
         attributed = sum(phases.values())
         idle = max(wall - attributed, 0.0)
+        # idle decomposition: exclusive wait coverage on the un-attributed
+        # stretches of the window. The dispatch accumulators above occupy
+        # unswept wall time, so raw wait coverage can exceed the idle
+        # residual; scale the buckets down proportionally so
+        # buckets + remainder always equal the old idle value exactly.
+        wait_iv = [
+            (res, s, e) for res, s, e in wait_iv_all
+            if min(e, hi) > max(s, lo)
+        ]
+        waits = _sweep_waits(per_era_iv[era], wait_iv, lo, hi)
+        wsum = sum(waits.values())
+        if wsum > idle and wsum > 0:
+            scale = idle / wsum
+            waits = {r: v * scale for r, v in waits.items()}
+            wsum = idle
+        unattr = max(idle - wsum, 0.0)
+        cpath = _critical_path(
+            [("phase", p, s, e) for p, s, e in per_era_iv[era]]
+            + [("wait", res, s, e) for res, s, e in wait_iv],
+            lo,
+            hi,
+        )
         # per-device utilization row: union of mesh.device (dispatch ->
         # ready) windows clipped to this era, all_gather bytes pro-rated by
         # the clipped fraction. busy/wall is an upper bound on device
@@ -631,6 +831,14 @@ def era_report(
                 "wall_s": round(wall, 6),
                 "phases_s": {p: round(phases[p], 6) for p in PHASES},
                 "idle_s": round(idle, 6),
+                "waits_s": {
+                    r: round(waits.get(r, 0.0), 6) for r in WAIT_RESOURCES
+                },
+                "idle_unattributed_s": round(unattr, 6),
+                "idle_unattributed_fraction": round(unattr / idle, 4)
+                if idle > 0
+                else 0.0,
+                "critical_path": cpath,
                 "overlap_s": round(overlap, 6),
                 "attributed_s": round(attributed, 6),
                 "coverage": round(
@@ -653,16 +861,20 @@ def era_report_table(report: Optional[dict] = None) -> str:
         report = era_report()
     cols = (
         ["era", "wall_s"] + list(PHASES)
-        + ["idle_s", "overlap_s", "dev_util"]
+        + ["idle_s"] + [f"w:{r}" for r in WAIT_RESOURCES]
+        + ["unattr_s", "overlap_s", "dev_util"]
     )
     rows = [cols]
     for ent in report["eras"]:
         dev = ent.get("device") or {}
+        waits = ent.get("waits_s") or {}
         rows.append(
             [str(ent["era"]), f"{ent['wall_s']:.3f}"]
             + [f"{ent['phases_s'][p]:.3f}" for p in PHASES]
+            + [f"{ent['idle_s']:.3f}"]
+            + [f"{waits.get(r, 0.0):.3f}" for r in WAIT_RESOURCES]
             + [
-                f"{ent['idle_s']:.3f}",
+                f"{ent.get('idle_unattributed_s', 0.0):.3f}",
                 f"{ent.get('overlap_s', 0.0):.3f}",
                 f"{dev.get('util', 0.0):.3f}",
             ]
@@ -676,6 +888,28 @@ def era_report_table(report: Optional[dict] = None) -> str:
     ]
     lines.insert(1, "  ".join("-" * w for w in widths))
     return "\n".join(lines)
+
+
+def critical_path_table(report: Optional[dict] = None) -> str:
+    """Plain-text per-era critical-path chains (CLI `trace
+    --critical-path`): each era's longest blocking chain from start to
+    commit, one row per merged segment, offsets relative to era start."""
+    if report is None:
+        report = era_report()
+    lines: List[str] = []
+    for ent in report["eras"]:
+        cp = ent.get("critical_path") or {}
+        lines.append(
+            f"era {ent['era']}: critical path "
+            f"{cp.get('total_s', 0.0):.3f}s "
+            f"(era wall {ent['wall_s']:.3f}s)"
+        )
+        for sg in cp.get("segments", ()):
+            lines.append(
+                f"  {sg['start_s']:>10.3f}s -> {sg['end_s']:>10.3f}s  "
+                f"{sg['dur_s']:>9.3f}s  {sg['kind']}:{sg['name']}"
+            )
+    return "\n".join(lines) if lines else "<no completed eras in trace ring>"
 
 
 def set_capacity(n: int) -> None:

@@ -3,6 +3,7 @@ GET /healthz liveness surface: the verdict must flip ok -> stalled when
 commits stop and recover to ok on the next persisted block."""
 import asyncio
 import random
+import time
 
 import pytest
 
@@ -126,6 +127,49 @@ async def _get(port, path):
     writer.close()
     head, _, body = raw.partition(b"\r\n\r\n")
     return int(head.split(b" ", 2)[1]), body
+
+
+def test_healthz_idle_fraction_alert_over_http():
+    """ISSUE-16 idle-anatomy alert, end to end through the HTTP layer: a
+    node whose rolling era idle fraction exceeds the configured
+    observability.idleAlertFraction reads degraded (200, load balancers
+    keep routing) and recovers when the threshold is lifted."""
+    import json
+
+    from lachain_tpu.utils import tracing
+
+    node = _solo_node()
+    tracing.reset_for_tests()
+    try:
+        # one completed era that is 100% idle: an era span with no
+        # attributed phase work inside it
+        with tracing.span("era", era=0):
+            time.sleep(0.02)
+
+        async def run():
+            server = await node.start_rpc(api_key="sekrit")
+            try:
+                # threshold unset: pure idle is not a symptom
+                status, body = await _get(server.port, "/healthz")
+                h = json.loads(body)
+                assert status == 200 and h["status"] == "ok"
+                assert h["idleFraction"] is None
+                node.idle_alert_fraction = 0.5
+                status, body = await _get(server.port, "/healthz")
+                h = json.loads(body)
+                assert status == 200  # degraded, not stalled: no 503
+                assert h["status"] == "degraded"
+                assert h["idleFraction"] is not None
+                assert h["idleFraction"] > 0.5
+                node.idle_alert_fraction = None
+                status, body = await _get(server.port, "/healthz")
+                assert json.loads(body)["status"] == "ok"
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+    finally:
+        tracing.reset_for_tests()
 
 
 def test_healthz_http_flip_on_gated_server():
