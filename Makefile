@@ -7,7 +7,7 @@
 PYTEST ?= python -m pytest
 PYTEST_ARGS ?= -q
 
-.PHONY: test test-kernel test-fast test-chaos test-storage \
+.PHONY: test test-kernel test-fast test-chaos test-byzantine test-storage \
 	test-observability test-sync test-pipeline test-exec test-trie \
 	test-mesh native bench bench-gate lint sanitize sanitize-tsan
 
@@ -30,6 +30,15 @@ test-fast:
 # slow-marked mesh differentials run in their own job, not here)
 test-chaos:
 	$(PYTEST) $(PYTEST_ARGS) -m "(chaos or crash or slow) and not mesh"
+
+# smart-malicious adversaries: the strategy fleet (equivocate/withhold/
+# relay/spam/vote-flip), dual-engine verdict identity, evidence
+# durability + fsck, malicious-protocol subclass tests. The slice to run
+# after touching consensus/adversary.py, consensus/evidence.py, the
+# first-seen latches (era.py / consensus_rt.cpp opq_latch) or the
+# evidence RPC/report surfaces
+test-byzantine:
+	$(PYTEST) $(PYTEST_ARGS) -m "byzantine and not slow"
 
 # durable-store engines: LSM differential/crash/compaction tests, trie +
 # state snapshots, crash-point matrix, fsck, CLI db verbs. Overlaps the

@@ -582,6 +582,22 @@ def cmd_chaos(args) -> int:
         # storage crash scenario: orthogonal to the network fault plan (a
         # SIGKILLed child + fsck + resume, not an in-process devnet)
         return _run_crash_point_scenario(args)
+    adversary = None
+    if args.byzantine:
+        from .consensus.adversary import AdversaryPlan
+
+        traitors = (
+            tuple(int(t) for t in args.traitors.split(","))
+            if args.traitors
+            else tuple(range(args.f))
+        )
+        try:
+            adversary = AdversaryPlan(
+                strategy=args.byzantine, traitors=traitors, seed=args.seed
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     plan = FaultPlan(
         seed=args.seed,
         drop=args.drop,
@@ -602,6 +618,11 @@ def cmd_chaos(args) -> int:
         f"delay={plan.delay} reorder={plan.reorder} "
         f"crashes={len(plan.crashes)} partitions={len(plan.partitions)}"
     )
+    if adversary is not None:
+        print(
+            f"byzantine: strategy={adversary.strategy} "
+            f"traitors={list(adversary.traitors)} seed={adversary.seed}"
+        )
     try:
         net = Devnet(
             n=args.n,
@@ -609,6 +630,7 @@ def cmd_chaos(args) -> int:
             seed=args.seed,
             fault_plan=plan,
             engine=args.engine,
+            adversary=adversary,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -625,11 +647,20 @@ def cmd_chaos(args) -> int:
             print(f"era {era:>3}: FAILED ({e})")
             continue
         dt = time.perf_counter() - t0
+        era_ev = ""
+        if adversary is not None:
+            from .consensus.evidence import era_counts
+
+            counts = era_counts(era)
+            era_ev = (
+                f" equivocations={counts.get('equivocation', 0)}"
+                f" invalid_shares={counts.get('invalid_share', 0)}"
+            )
         print(
             f"era {era:>3}: block {blocks[0].hash().hex()[:16]} "
             f"msgs={net.net.delivered_count - delivered0} "
             f"recovery_rounds={getattr(net.net, 'recovery_rounds', 0) - recov0} "
-            f"{dt:.2f}s"
+            f"{dt:.2f}s{era_ev}"
         )
     faults = getattr(net.net, "faults", None)
     if faults is not None:
@@ -641,6 +672,22 @@ def cmd_chaos(args) -> int:
         f"{getattr(net.net, 'recovery_rounds', 0)} "
         f"outbox_replayed={int(replayed)} outbox_evicted={int(evicted)}"
     )
+    if adversary is not None:
+        # evidence identity: honest nodes must have detected the SAME set
+        honest = [
+            i for i in range(args.n) if i not in adversary.traitors
+        ]
+        sets = [net.net.routers[i].evidence.record_set() for i in honest]
+        shed = metrics.counter_value(
+            "consensus_msgs_shed_total", labels={"reason": "latch_cap"}
+        )
+        print(
+            f"byzantine report: evidence_records={len(sets[0])} "
+            f"evidence_identical={all(s == sets[0] for s in sets)} "
+            f"latch_shed={int(shed)}"
+        )
+        for rec in net.net.routers[honest[0]].evidence.snapshot():
+            print(f"  evidence: {json.dumps(rec, sort_keys=True)}")
     heights = [net.height(i) for i in range(args.n)]
     print(f"heights: {heights}")
     if failures or len(set(heights)) != 1:
@@ -1149,6 +1196,22 @@ def main(argv=None) -> int:
                     help="storage crash scenario: SIGKILL a child workload "
                          "at this pipeline point (see storage/crashpoints.py"
                          " for names), then fsck + resume; repeatable")
+    ch.add_argument("--byzantine", default=None,
+                    metavar="STRATEGY",
+                    choices=["equivocate", "withhold", "relay", "spam",
+                             "equivocate_votes"],
+                    help="smart-malicious traitors (consensus/adversary.py):"
+                         " equivocate (conflicting coin/TPKE shares per"
+                         " slot), withhold (shares to only f+1 seeded"
+                         " recipients), relay (seeded replay of captured"
+                         " signed frames, spoofed origin), spam (flood"
+                         " distinct coin slots past the latch budget),"
+                         " equivocate_votes (AUX/CONF flip, python engine"
+                         " only); prints per-era evidence + recovery report")
+    ch.add_argument("--traitors", default=None,
+                    metavar="I,J,...",
+                    help="comma-separated traitor ids for --byzantine "
+                         "(default: validators 0..f-1)")
     ch.set_defaults(fn=cmd_chaos)
 
     fs = sub.add_parser(

@@ -1,0 +1,308 @@
+"""Smart-malicious adversary fleet: seeded, deterministic misbehaviour.
+
+Where FaultPlan (faults.py) models an UNRELIABLE network — loss, delay,
+reorder, crash windows — this module models MALICIOUS validators: nodes that
+hold real key shares and use them to attack the protocol from the inside.
+Every strategy is a pure function of (plan.seed, traitor id, payload bytes),
+so two runs with the same plan are bit-identical — the same property
+FaultPlan pins for fault schedules — and the SAME misbehaviour plays out on
+both the Python-protocol engine and the native engine (traitors fall back to
+Python protocol overrides on the native engine so the wrappers see typed
+payloads; honest validators stay fully native). tests/test_consensus_adversary.py
+pins cross-engine identity of committed blocks AND evidence sets.
+
+Strategies:
+  equivocate        broadcast the real TPKE decryption share / coin share,
+                    then a CONFLICTING well-formed variant for the same slot
+                    (coin: a real threshold signature over an altered
+                    message; dec: the real U_i point multiplied by a scalar,
+                    correct trailing ids). Every honest node's first-seen
+                    latch records an equivocation and drops the second
+                    payload, so liveness holds and evidence is deterministic.
+  withhold          ship coin + decryption shares to only f+1 seeded
+                    recipients (always including the traitor itself) — the
+                    threshold-boundary starvation attack. Tolerated: honest
+                    nodes still hold n-f >= f+1 honest shares; no evidence.
+  relay             adversarial relay: replay a seeded ~25% of the signed
+                    coin/dec frames the traitor receives, spoofing the
+                    original sender, to a seeded target subset. Decisions
+                    key on (sender, slot) identity — not bytes, because
+                    TPKE ciphertexts are randomized per run. Replayed
+                    bytes are identical, so latches pass them through and
+                    protocol dedupe absorbs them; no evidence, no forks.
+  spam              flood a burst of distinct well-formed coin slots (junk
+                    share bytes, valid length + trailing id) once per era:
+                    exercises the per-sender first-seen latch budget. Honest
+                    nodes shed past the cap (consensus_msgs_shed_total,
+                    reason="latch_cap" — and the native engine's identical
+                    opq_latch_cap) and keep committing.
+  equivocate_votes  AUX/CONF vote equivocation (flip the vote, double-send).
+                    Python engine only: BB state machines are engine-typed
+                    messages on the native engine and cannot be overridden.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from . import messages as M
+
+STRATEGIES = (
+    "equivocate",
+    "withhold",
+    "relay",
+    "spam",
+    "equivocate_votes",
+)
+
+
+@dataclass(frozen=True)
+class AdversaryPlan:
+    """A deterministic misbehaviour schedule for a set of traitor ids."""
+
+    strategy: str
+    traitors: Tuple[int, ...]
+    seed: int = 0
+    # knobs
+    spam_slots: int = 2600  # distinct flooded latch slots (> latch cap 2048)
+    relay_fanout: int = 2  # replay targets per captured frame
+    relay_rate: int = 4  # replay 1-in-N captured frames
+
+    def __post_init__(self):
+        if self.strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown adversary strategy {self.strategy!r}; "
+                f"expected one of {STRATEGIES}"
+            )
+        object.__setattr__(self, "traitors", tuple(self.traitors))
+
+
+def _h(seed: int, *parts) -> int:
+    """Stateless seeded decision hash: identical across engines and runs
+    because it depends only on the plan seed and payload-derived parts."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(seed).encode())
+    for p in parts:
+        h.update(p if isinstance(p, bytes) else str(p).encode())
+        h.update(b"|")
+    return int.from_bytes(h.digest(), "big")
+
+
+def _subset(seed: int, tag, me: int, n: int, size: int) -> Tuple[int, ...]:
+    """Seeded choice of `size` validators out of range(n) minus `me`."""
+    others = [t for t in range(n) if t != me]
+    others.sort(key=lambda t: _h(seed, tag, t))
+    return tuple(sorted(others[:size]))
+
+
+def _payload_bytes(payload) -> bytes:
+    if isinstance(payload, M.CoinMessage):
+        return payload.share
+    if isinstance(payload, M.DecryptedMessage):
+        return payload.payload
+    raise TypeError(f"unexpected payload {type(payload)}")
+
+
+def _payload_era(payload) -> int:
+    if isinstance(payload, M.CoinMessage):
+        return payload.coin.era
+    return payload.hb.era
+
+
+def conflicting_variant(router, payload):
+    """A well-formed payload for the SAME slot that differs from `payload`:
+    the equivocation pair. Built from the traitor's REAL key material."""
+    if isinstance(payload, M.CoinMessage):
+        from ..crypto import threshold_sig as ts
+
+        signer = ts.ThresholdSigner(
+            payload.coin.to_bytes() + b"/equivocate",
+            router.private_keys.ts_share,
+            router.public_keys.ts_keys,
+        )
+        return M.CoinMessage(coin=payload.coin, share=signer.sign().to_bytes())
+    if isinstance(payload, M.DecryptedMessage):
+        from ..crypto import bls12381 as bls
+        from ..crypto import tpke
+
+        dec = tpke.PartiallyDecryptedShare.from_bytes(payload.payload)
+        alt = tpke.PartiallyDecryptedShare(
+            ui=bls.g1_mul(dec.ui, 1337),
+            decryptor_id=dec.decryptor_id,
+            share_id=dec.share_id,
+        )
+        return M.DecryptedMessage(
+            hb=payload.hb, share_id=payload.share_id, payload=alt.to_bytes()
+        )
+    raise TypeError(f"unexpected payload {type(payload)}")
+
+
+def _flip_vote(payload):
+    if isinstance(payload, M.AuxMessage):
+        return M.AuxMessage(bb=payload.bb, value=not payload.value)
+    return M.ConfMessage(
+        bb=payload.bb, values=frozenset({True, False}) - payload.values
+        or frozenset({True}),
+    )
+
+
+# -- transport shims ---------------------------------------------------------
+
+
+def _is_native(net) -> bool:
+    return hasattr(net, "_send_opaque")
+
+
+def _make_injector(net):
+    """Return inject(sender, target, payload): enqueue a payload AS IF
+    `sender` sent it (spoofing allowed), bypassing the sender's router and
+    its journal latch. target None = broadcast to all n, in target order —
+    identical ordering on both engines, so TAKE_FIRST runs stay aligned."""
+    if not _is_native(net):
+        return net.inject
+
+    from .native_rt import KIND_COIN, KIND_DECRYPTED
+
+    def inject(sender: int, target: Optional[int], payload) -> None:
+        if isinstance(payload, M.CoinMessage):
+            kind = KIND_COIN
+            agreement, epoch = payload.coin.agreement, payload.coin.epoch
+        else:
+            kind = KIND_DECRYPTED
+            agreement, epoch = payload.share_id, 0
+        data = _payload_bytes(payload)
+        era = _payload_era(payload)
+        targets = range(net.n) if target is None else (target,)
+        for t in targets:
+            net._send_opaque(sender, t, kind, agreement, epoch, data, era=era)
+
+    return inject
+
+
+def _force_python_protocols(router) -> None:
+    """Native engine traitors run Coin/HB (and thus Root) as Python protocol
+    overrides, flowing through the legacy cb_opaque path — the wrappers below
+    need typed payload objects, which the engine-hosted path never builds."""
+    from .common_coin import CommonCoin
+    from .honey_badger import HoneyBadger
+
+    fac = router._extra_factories
+    fac.setdefault(
+        M.CoinId,
+        lambda pid, r: CommonCoin(
+            pid, r, r.private_keys.ts_share, r.public_keys.ts_keys
+        ),
+    )
+    fac.setdefault(
+        M.HoneyBadgerId,
+        lambda pid, r: HoneyBadger(pid, r, r.public_keys, r.private_keys),
+    )
+
+
+# -- installation ------------------------------------------------------------
+
+
+def install(plan: AdversaryPlan, net) -> None:
+    """Mutate `net` in place: each traitor's router gets the plan's
+    misbehaviour. Call after network construction, before the first run
+    (the native ownership mask is computed lazily, so post-construction
+    override installation is supported by contract)."""
+    native = _is_native(net)
+    if plan.strategy == "equivocate_votes" and native:
+        raise ValueError(
+            "equivocate_votes needs Python BB protocols; the native engine "
+            "types BVAL/AUX/CONF messages internally"
+        )
+    for v in plan.traitors:
+        if not 0 <= v < net.n:
+            raise ValueError(f"traitor id {v} out of range for n={net.n}")
+        _install_traitor(plan, net, v)
+
+
+def _install_traitor(plan: AdversaryPlan, net, v: int) -> None:
+    router = net.routers[v]
+    if _is_native(net):
+        _force_python_protocols(router)
+    inject = _make_injector(net)
+    f = router.public_keys.f
+    orig_broadcast = router.broadcast
+    spammed_eras = set()
+
+    def broadcast(payload) -> None:
+        share_like = isinstance(payload, (M.CoinMessage, M.DecryptedMessage))
+        if plan.strategy == "withhold" and share_like:
+            # threshold-boundary starvation: f+1 recipients only (self
+            # always included so the traitor's own protocols stay live)
+            era = _payload_era(payload)
+            proto = type(payload).__name__
+            for t in _subset(plan.seed, ("withhold", v, era, proto), v, net.n, f):
+                inject(v, t, payload)
+            inject(v, v, payload)
+            return
+        orig_broadcast(payload)
+        if plan.strategy == "equivocate" and share_like:
+            inject(v, None, conflicting_variant(router, payload))
+        elif plan.strategy == "equivocate_votes" and isinstance(
+            payload, (M.AuxMessage, M.ConfMessage)
+        ):
+            net.inject(v, None, _flip_vote(payload))
+        elif plan.strategy == "spam" and isinstance(payload, M.CoinMessage):
+            era = payload.coin.era
+            if era not in spammed_eras:
+                spammed_eras.add(era)
+                _flood(plan, net, v, era, inject)
+
+    router.broadcast = broadcast
+
+    if plan.strategy == "relay":
+        orig_dispatch = router.dispatch_external
+        replayed: dict = {}  # era -> frame keys already replayed (once each)
+
+        def dispatch_external(sender: int, payload) -> None:
+            orig_dispatch(sender, payload)
+            if sender == v or not isinstance(
+                payload, (M.CoinMessage, M.DecryptedMessage)
+            ):
+                return
+            era = _payload_era(payload)
+            seen = replayed.setdefault(era, set())
+            for stale in [e for e in replayed if e < era - 1]:
+                del replayed[stale]  # bounded memory across campaigns
+            # decision key is the SLOT identity, never the payload bytes:
+            # TPKE ciphertexts are randomized (crypto/tpke.py encrypt), so
+            # dec-share bytes differ run to run while the slot schedule is
+            # bit-stable — byte-keyed decisions would break two-run and
+            # cross-engine replay identity
+            if isinstance(payload, M.CoinMessage):
+                slot = ("coin", era, payload.coin.agreement, payload.coin.epoch)
+            else:
+                slot = ("dec", era, payload.share_id)
+            key = _h(plan.seed, "relay", v, sender, slot)
+            # replay each captured frame AT MOST ONCE: replays of replays
+            # (including our own frames echoed back) must not cascade
+            if key % plan.relay_rate == 0 and key not in seen:
+                seen.add(key)
+                for t in _subset(
+                    plan.seed, ("rtgt", v, key), sender, net.n, plan.relay_fanout
+                ):
+                    inject(sender, t, payload)
+
+        router.dispatch_external = dispatch_external
+
+
+def _flood(plan: AdversaryPlan, net, v: int, era: int, inject) -> None:
+    """Spam burst: distinct well-formed coin slots that each claim a
+    first-seen latch entry. Length + trailing-id checks pass, so the only
+    backstop is the per-sender latch budget — which is the point."""
+    from ..crypto import bls12381 as bls
+
+    for k in range(plan.spam_slots):
+        cid = M.CoinId(era=era, agreement=v, epoch=100_000 + k)
+        junk = (
+            hashlib.blake2b(
+                b"%d|spam|%d|%d" % (plan.seed, v, k), digest_size=32
+            ).digest()
+            * ((bls.G2_BYTES + 31) // 32)
+        )[: bls.G2_BYTES] + v.to_bytes(4, "big")
+        inject(v, None, M.CoinMessage(coin=cid, share=junk))

@@ -35,6 +35,7 @@ class CommonCoin(Protocol):
         # deserialize+subgroup check instead of a full-order mul per point
         self._raw: dict = {}
         self._parsed: set = set()
+        self._flagged: set = set()  # senders already reported as evidence
 
     def handle_input(self, value) -> None:
         if self._requested:
@@ -84,6 +85,7 @@ class CommonCoin(Protocol):
             for s, pt in zip(pending, pts):
                 self._parsed.add(s)
                 if pt is None:
+                    self._flag_invalid(s)
                     continue  # malformed/bad-subgroup share: drop
                 # deferred verification: the signer checks the COMBINED
                 # signature (2 pairings total) and only falls back to the
@@ -92,6 +94,20 @@ class CommonCoin(Protocol):
                     ts.PartialSignature(sigma=pt, signer_id=s), verify=False
                 )
         sig = self._signer.signature
+        # shares the signer's batch verifier pruned (well-formed points
+        # carrying a signature over the wrong message) are evidence too
+        for s in self._signer.pruned - self._flagged:
+            self._flag_invalid(s)
         if sig is not None:
             self._done = True
             self.emit_result(sig.parity)
+
+    def _flag_invalid(self, sender: int) -> None:
+        if sender in self._flagged:
+            return
+        self._flagged.add(sender)
+        ev = getattr(self.broadcaster, "evidence", None)
+        if ev is not None:
+            ev.record_invalid_share(
+                self.id.era, sender, "coin", (self.id.agreement, self.id.epoch)
+            )

@@ -44,6 +44,7 @@ class EraRouter(Broadcaster):
         send: Callable[[Optional[int], Any], None],
         extra_factories: Optional[Dict[type, Callable]] = None,
         journal=None,
+        evidence=None,
     ):
         """`send(target, payload)`: target None = broadcast to all validators
         (including self-delivery handled by the transport). `journal` is an
@@ -65,6 +66,26 @@ class EraRouter(Broadcaster):
         self._postponed: list = []
         self._postponed_per_sender: Dict[int, int] = {}
         self._postponed_sender_cap = 256
+        # Byzantine evidence store (evidence.py): detected equivocations and
+        # invalid shares, deduped + queryable (la_getEvidence). Injectable so
+        # the real node can persist it on its KV.
+        if evidence is None:
+            from .evidence import EvidenceStore
+
+            evidence = EvidenceStore()
+        self.evidence = evidence
+        # per-(sender, slot) first-seen latch: the receive-side dual of the
+        # _sent_slots send latch. The FIRST payload a sender ships for a
+        # decision slot is pinned; a LATER DIFFERING payload for the same
+        # slot is equivocation — recorded as evidence and dropped, so the
+        # first-seen value keeps driving the protocol deterministically.
+        # Bounded per sender so a spammer inventing fresh slots degrades
+        # itself (shed + counted), not this node. The native engine applies
+        # the IDENTICAL rule to engine-delivered share traffic
+        # (consensus_rt.cpp opq_latch), reporting conflicts via XO_EVIDENCE.
+        self._first_seen: Dict[tuple, Any] = {}
+        self._first_seen_per_sender: Dict[int, int] = {}
+        self.first_seen_sender_cap = 2048
         # retransmission outbox: every payload this router sent, per era
         # (target None = broadcast), bounded FIFO. Consensus protocols never
         # retransmit on their own, so a message lost in transit is
@@ -240,15 +261,65 @@ class EraRouter(Broadcaster):
                 if cnt < self._postponed_sender_cap:
                     self._postponed_per_sender[sender] = cnt + 1
                     self._postponed.append((sender, payload))
+                else:
+                    # per-sender buffer full: the spammer's traffic sheds,
+                    # honest senders' buffers are unaffected
+                    from ..utils import metrics
+
+                    metrics.inc(
+                        "consensus_msgs_shed_total",
+                        labels={"reason": "postponed_cap"},
+                    )
             else:
                 logger.debug("stale era message %s from %d", pid, sender)
             return
         if not self._validate_id(pid):
             logger.warning("invalid protocol id %s from %d", pid, sender)
             return
+        if not self._latch_first_seen(sender, payload):
+            return  # equivocation (recorded) or latch-budget shed
         proto = self._ensure_protocol(pid)
         if proto is not None:
             proto.receive(M.External(sender=sender, payload=payload))
+
+    def _latch_first_seen(self, sender: int, payload) -> bool:
+        """Receive-side equivocation latch. Returns False when the payload
+        must be dropped: either it CONFLICTS with the sender's first-seen
+        payload for the slot (evidence recorded), or the sender exhausted
+        its latch budget (shed, counted). Byte-identical duplicates pass
+        through — the protocols' own dedup handles them, exactly as the
+        native engine passes equal-bytes duplicates."""
+        slot = send_slot(payload)
+        if slot is None:
+            return True
+        key = (sender, slot)
+        prev = self._first_seen.get(key)
+        if prev is None:
+            cnt = self._first_seen_per_sender.get(sender, 0)
+            if cnt >= self.first_seen_sender_cap:
+                from ..utils import metrics
+
+                metrics.inc(
+                    "consensus_msgs_shed_total",
+                    labels={"reason": "latch_cap"},
+                )
+                return False
+            self._first_seen_per_sender[sender] = cnt + 1
+            self._first_seen[key] = payload
+            return True
+        if prev == payload:
+            return True
+        from .evidence import describe_slot
+
+        proto, index = describe_slot(slot)
+        if self.evidence.record_equivocation(
+            self._payload_era(payload), sender, proto, index
+        ):
+            logger.warning(
+                "equivocation from %d in slot %s%s: conflicting payloads",
+                sender, proto, index,
+            )
+        return False
 
     def advance_era(self, new_era: int) -> None:
         """Move FORWARD to a new era and replay buffered future-era messages
@@ -314,6 +385,20 @@ class EraRouter(Broadcaster):
             del self._outbox[e]
         for key in [k for k in self._sent_slots if k[0] < cutoff]:
             del self._sent_slots[key]
+        # first-seen latch follows protocol retention (slot[1] is the
+        # protocol id; its era keys the entry, like _sent_slots)
+        for key in [
+            k
+            for k in self._first_seen
+            if getattr(k[1][1], "era", cutoff) < cutoff
+        ]:
+            sender = key[0]
+            cnt = self._first_seen_per_sender.get(sender, 0)
+            if cnt > 1:
+                self._first_seen_per_sender[sender] = cnt - 1
+            else:
+                self._first_seen_per_sender.pop(sender, None)
+            del self._first_seen[key]
         if self._journal is not None:
             self._journal.prune_below(cutoff)
 

@@ -1,0 +1,257 @@
+"""Byzantine evidence: durable records of detected misbehavior.
+
+Two detection families feed this module:
+
+  * **equivocation** — one validator sent two DIFFERENT payloads for the
+    same per-era decision slot (journal.send_slot is the slot key). The
+    router-level first-seen latch (era.py::dispatch_external) catches it on
+    the Python engine; the native engine's opaque latch (consensus_rt.cpp)
+    catches it for engine-delivered share traffic and reports it through the
+    XO_EVIDENCE crossing — the SAME normalized record on both engines, which
+    is what the dual-engine identity tests pin.
+  * **invalid_share** — a share/signature that parses or arrives but fails
+    cryptographic verification at a combine boundary: TPKE decryption shares
+    (honey_badger.py / native_hosts.HoneyBadgerHost), threshold-signature
+    coin shares (common_coin.py / native_hosts.CoinHost via
+    ThresholdSigner.pruned), and ECDSA header signatures (root_protocol.py /
+    native_hosts.RootHost).
+
+Records are DEDUPLICATED (a set keyed by the full record tuple), so spam
+re-detection cannot grow the store, and **persisted before the metric is
+published** through the KV's batched fsynced path (the same
+persist-before-transmit discipline as the consensus send journal —
+tools/check_invariants.py rule E pins both properties). The store is
+queryable via ``la_getEvidence`` (rpc/service.py) and surfaced as the
+``consensus_equivocations_total`` / ``consensus_invalid_shares_total``
+counters plus per-era counts in ``era_report()``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import metrics
+
+EQUIVOCATION = "equivocation"
+INVALID_SHARE = "invalid_share"
+
+_KIND_CODES = {EQUIVOCATION: 1, INVALID_SHARE: 2}
+_KIND_NAMES = {v: k for k, v in _KIND_CODES.items()}
+_KIND_METRICS = {
+    EQUIVOCATION: "consensus_equivocations_total",
+    INVALID_SHARE: "consensus_invalid_shares_total",
+}
+
+
+@dataclass(frozen=True, order=True)
+class EvidenceRecord:
+    """One detected offense, normalized to plain ints/strings so records are
+    directly comparable across engines and across process restarts."""
+
+    era: int
+    kind: str  # EQUIVOCATION | INVALID_SHARE
+    offender: int
+    proto: str  # "dec" | "coin" | "hdr" | "aux" | "conf" | "bval" | ...
+    index: Tuple[int, ...]  # proto-specific slot coordinates
+
+    def to_dict(self) -> dict:
+        return {
+            "era": self.era,
+            "kind": self.kind,
+            "offender": self.offender,
+            "proto": self.proto,
+            "index": list(self.index),
+        }
+
+    def encode(self) -> bytes:
+        from ..utils.serialization import write_bytes, write_u64
+
+        out = write_u64(self.era)
+        out += bytes([_KIND_CODES[self.kind]])
+        out += write_u64(self.offender)
+        out += write_bytes(self.proto.encode("ascii"))
+        out += write_u64(len(self.index))
+        for i in self.index:
+            # index coordinates are small non-negatives (slot/agreement/
+            # epoch/value); bias by 1 so agreement=-1 (nonce coin) round-trips
+            out += write_u64(i + 1)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "EvidenceRecord":
+        from ..utils.serialization import Reader
+
+        r = Reader(data)
+        era = r.u64()
+        kind = _KIND_NAMES[r.raw(1)[0]]
+        offender = r.u64()
+        proto = r.bytes_().decode("ascii")
+        count = r.u64()
+        index = tuple(r.u64() - 1 for _ in range(count))
+        return cls(
+            era=era, kind=kind, offender=offender, proto=proto, index=index
+        )
+
+
+def describe_slot(slot: tuple) -> Tuple[str, Tuple[int, ...]]:
+    """Normalize a journal.send_slot key — (tag, protocol-id, extras...) —
+    into the flat (proto, index) coordinates an EvidenceRecord carries.
+    The native engine builds the SAME coordinates from its wire fields
+    (kind/agreement/epoch), which is what makes evidence sets comparable
+    across engines."""
+    tag = slot[0]
+    pid = slot[1]
+    if tag == "dec":
+        return "dec", (int(slot[2]),)
+    if tag == "coin":
+        return "coin", (int(pid.agreement), int(pid.epoch))
+    if tag == "hdr":
+        return "hdr", ()
+    if tag == "val":
+        return "val", (int(pid.sender_id), int(slot[2]))
+    if tag in ("echo", "ready"):
+        return tag, (int(pid.sender_id),)
+    if tag in ("aux", "conf"):
+        return tag, (int(pid.agreement), int(pid.epoch))
+    if tag == "bval":
+        return "bval", (int(pid.agreement), int(pid.epoch), int(slot[2]))
+    return tag, ()
+
+
+# -- per-era pressure counters (era_report integration) -----------------------
+# process-wide so `trace --era-report` can show Byzantine pressure per era
+# without threading a store through the tracing module; reset with the trace
+_era_counts: Dict[int, Dict[str, int]] = {}
+
+
+def _bump_era(era: int, kind: str) -> None:
+    per = _era_counts.setdefault(int(era), {})
+    per[kind] = per.get(kind, 0) + 1
+
+
+def era_counts(era: Optional[int] = None) -> Dict:
+    """Per-era evidence counts: {era: {kind: n}} (or one era's {kind: n})."""
+    if era is not None:
+        return dict(_era_counts.get(int(era), {}))
+    return {e: dict(kinds) for e, kinds in _era_counts.items()}
+
+
+def reset_era_counts() -> None:
+    _era_counts.clear()
+
+
+class EvidenceStore:
+    """Deduplicated, optionally KV-persisted store of EvidenceRecords.
+
+    One store per validator (owned by its EraRouter). Records persist under
+    ``EntryPrefix.EVIDENCE`` via ``write_batch`` — the KV's fsynced path —
+    BEFORE the detection metric is published, and are reloaded on restart,
+    so an accusation survives a crash (storage/fsck.py validates the
+    keyspace). Dedup is by full record identity: re-detecting the same
+    offense (spam replays, outbox replays) is free."""
+
+    def __init__(self, kv=None, cap: int = 4096):
+        self._kv = kv
+        self.cap = cap
+        self._records: set = set()
+        self._ordered: List[EvidenceRecord] = []
+        self._next_seq = 0
+        if kv is not None:
+            self._load()
+
+    # -- persistence ----------------------------------------------------------
+    def _prefix(self) -> bytes:
+        from ..storage.kv import EntryPrefix, prefixed
+
+        return prefixed(EntryPrefix.EVIDENCE)
+
+    def _load(self) -> None:
+        prefix = self._prefix()
+        for key, value in self._kv.scan_prefix(prefix):
+            tail = key[len(prefix):]
+            if len(tail) != 8:
+                continue
+            try:
+                rec = EvidenceRecord.decode(value)
+            except Exception:
+                continue  # fsck reports + repairs undecodable records
+            seq = int.from_bytes(tail, "big")
+            self._next_seq = max(self._next_seq, seq + 1)
+            if rec not in self._records:
+                self._records.add(rec)
+                self._ordered.append(rec)
+
+    def _persist(self, rec: EvidenceRecord) -> None:
+        if self._kv is None:
+            return
+        from ..utils.serialization import write_u64
+
+        key = self._prefix() + write_u64(self._next_seq)
+        self._next_seq += 1
+        self._kv.write_batch([(key, rec.encode())])
+
+    # -- recording ------------------------------------------------------------
+    def _record(self, rec: EvidenceRecord) -> bool:
+        if rec in self._records:
+            return False
+        if len(self._ordered) >= self.cap:
+            # bounded store: evidence spam cannot grow memory without limit.
+            # The drop is counted, never silent.
+            metrics.inc("consensus_evidence_dropped_total")
+            return False
+        # durable BEFORE observable: the record hits the fsynced KV path
+        # before the counter moves (rule E, tools/check_invariants.py)
+        self._persist(rec)
+        self._records.add(rec)
+        self._ordered.append(rec)
+        metrics.inc(_KIND_METRICS[rec.kind], labels={"proto": rec.proto})
+        _bump_era(rec.era, rec.kind)
+        return True
+
+    def record_equivocation(
+        self, era: int, offender: int, proto: str, index: Tuple[int, ...]
+    ) -> bool:
+        return self._record(
+            EvidenceRecord(
+                era=int(era),
+                kind=EQUIVOCATION,
+                offender=int(offender),
+                proto=proto,
+                index=tuple(int(i) for i in index),
+            )
+        )
+
+    def record_invalid_share(
+        self, era: int, offender: int, proto: str, index: Tuple[int, ...]
+    ) -> bool:
+        return self._record(
+            EvidenceRecord(
+                era=int(era),
+                kind=INVALID_SHARE,
+                offender=int(offender),
+                proto=proto,
+                index=tuple(int(i) for i in index),
+            )
+        )
+
+    # -- queries --------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._ordered)
+
+    def records(self, era: Optional[int] = None) -> List[EvidenceRecord]:
+        if era is None:
+            return list(self._ordered)
+        return [r for r in self._ordered if r.era == era]
+
+    def record_set(self, era: Optional[int] = None) -> frozenset:
+        """The identity the dual-engine tests compare."""
+        return frozenset(self.records(era))
+
+    def snapshot(self, era: Optional[int] = None) -> List[dict]:
+        return [r.to_dict() for r in sorted(self.records(era))]
+
+    def counts(self, era: Optional[int] = None) -> Dict[str, int]:
+        out = {EQUIVOCATION: 0, INVALID_SHARE: 0}
+        for r in self.records(era):
+            out[r.kind] += 1
+        return out

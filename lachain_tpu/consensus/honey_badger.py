@@ -340,6 +340,7 @@ class HoneyBadger(Protocol):
                 failures += 1
                 del self._shares[slot][sender]
                 self._rejected.setdefault(slot, set()).add(sender)
+                self._flag_invalid(sender, slot)
             else:
                 self._parsed[(slot, sender)] = tpke.PartiallyDecryptedShare(
                     ui=pt, decryptor_id=sender, share_id=slot
@@ -369,9 +370,18 @@ class HoneyBadger(Protocol):
                 if not ok:
                     del slot_shares[d.decryptor_id]
                     self._rejected.setdefault(slot, set()).add(d.decryptor_id)
+                    self._flag_invalid(d.decryptor_id, slot)
         if len(valid) < need:
             return  # byzantine shares pruned; wait for more
         self._plaintexts[slot] = self._pub.tpke_pub.full_decrypt(ct, valid)
+
+    def _flag_invalid(self, sender: int, slot: int) -> None:
+        """A decryption share failed its parse or pairing check: record
+        the offense (evidence.py) on the router's store (when present —
+        unit harnesses may construct protocols without one)."""
+        ev = getattr(self.broadcaster, "evidence", None)
+        if ev is not None:
+            ev.record_invalid_share(self.id.era, sender, "dec", (slot,))
 
     def _try_complete(self) -> None:
         if self._done or self._ciphertexts is None:

@@ -446,6 +446,8 @@ enum CrossOp {
   XO_ROOT_SIGN = 8,      // a=nonce parity: build + sign header
   XO_ROOT_VERIFY = 9,    // blob [(u32 sender,u32 len,sig)...]: ECDSA verify
   XO_ROOT_PRODUCE = 10,  // assemble multisig + produce the block
+  XO_EVIDENCE = 11,      // a=offender b=opq_kind blob=be32 agreement+epoch:
+                         // conflicting payloads in one first-seen slot
 };
 
 // Python -> engine post ops (rt_post `op`).
@@ -676,6 +678,11 @@ struct Validator {
   NRoot* nroot = nullptr;
   std::vector<Entry> postponed;
   std::unordered_map<int, int> postponed_per_sender;
+  // first-seen opaque payload per (kind, sender, agreement, epoch): the
+  // equivocation latch (era.py::_latch_first_seen mirror). A conflicting
+  // second payload is reported via XO_EVIDENCE and dropped pre-delivery.
+  std::unordered_map<uint64_t, std::string> opq_seen;
+  std::unordered_map<int, int> opq_seen_count;
 
   void clear_protocols();  // defined after Engine (touches hb_queued_count)
 };
@@ -804,6 +811,7 @@ struct Engine {
   uint64_t opq_pending[8] = {0};  // queued opaque entries per kind (flush cue)
   bool stop_req = false;  // pulsed by Python on top-level protocol completion
   int postponed_sender_cap = 256;  // era.py::_postponed_sender_cap
+  int opq_latch_cap = 2048;        // era.py::first_seen_sender_cap
   int coin_need = 0;               // ts_keys.t + 1 (set from Python)
   uint64_t native_handled = 0;     // opaque deliveries handled without Python
   int hb_queued_count = 0;         // native HBs with a queued batcher build
@@ -991,6 +999,58 @@ struct Engine {
     return r;
   }
 
+  // -- equivocation latch (era.py::_latch_first_seen mirror) ----------------
+  // Returns false when the message must be dropped: either a conflicting
+  // payload in an already-latched slot (reported to Python as XO_EVIDENCE so
+  // both engines build identical evidence records) or a per-sender latch
+  // budget overflow (spam shed). Exact duplicates pass through — protocol
+  // state machines dedupe them, same as the Python path.
+  bool opq_latch(Validator& V, const Entry& e) {
+    Msg* m = e.m;
+    int agreement = m->agreement, epoch = m->epoch;
+    // mirror era.py::_validate_id bounds: out-of-range ids never reach a
+    // protocol, so they are not worth a latch slot
+    switch (m->opq_kind) {
+      case K_DECRYPTED:
+        if (agreement < 0 || agreement >= n) return true;
+        epoch = 0;  // unused by decrypt shares; one slot per share id
+        break;
+      case K_COIN:
+        if (!((agreement >= 0 && agreement < n) || agreement == -1) ||
+            epoch < 0)
+          return true;
+        break;
+      case K_SIGNED_HEADER:
+        agreement = 0;  // one header slot per sender per era
+        epoch = 0;
+        break;
+      default:
+        return true;
+    }
+    uint64_t key = ((uint64_t)(m->opq_kind & 3) << 62) |
+                   ((uint64_t)(uint32_t)(e.sender & 0x3FF) << 52) |
+                   ((uint64_t)((uint32_t)(agreement + 1) & 0x3FFFFFF) << 26) |
+                   (uint64_t)((uint32_t)epoch & 0x3FFFFFF);
+    auto it = V.opq_seen.find(key);
+    if (it == V.opq_seen.end()) {
+      int& cnt = V.opq_seen_count[e.sender];
+      if (cnt >= opq_latch_cap) return false;  // budget shed (spam defense)
+      cnt++;
+      V.opq_seen.emplace(key, m->data);
+      return true;
+    }
+    if (it->second == m->data) return true;  // duplicate: pass through
+    uint8_t blob[8];
+    uint32_t ua = (uint32_t)agreement, ue = (uint32_t)epoch;
+    blob[0] = (uint8_t)(ua >> 24); blob[1] = (uint8_t)(ua >> 16);
+    blob[2] = (uint8_t)(ua >> 8);  blob[3] = (uint8_t)ua;
+    blob[4] = (uint8_t)(ue >> 24); blob[5] = (uint8_t)(ue >> 16);
+    blob[6] = (uint8_t)(ue >> 8);  blob[7] = (uint8_t)ue;
+    cross(e.target, XO_EVIDENCE, e.sender, m->opq_kind,
+          std::string(reinterpret_cast<const char*>(blob), 8));
+    return false;
+  }
+
   // -- delivery (simulator.py::run + era.py::dispatch_external) -------------
   void deliver(const Entry& e) {
     Validator& V = vals[e.target];
@@ -1038,6 +1098,7 @@ struct Engine {
         break;
       }
       case MT_OPAQUE:
+        if (!opq_latch(V, e)) break;  // equivocation (reported) or shed
         if (deliver_native_opaque(V, e)) {
           native_handled++;
           break;
@@ -1545,6 +1606,8 @@ void Validator::clear_protocols() {
   delete nroot;
   nroot = nullptr;
   acs_to_hb = false;
+  opq_seen.clear();
+  opq_seen_count.clear();
 }
 
 void Engine::cross(int vid, int op, int a, int b, const std::string& blob) {
@@ -2111,7 +2174,7 @@ void NRoot::maybe_verify() {
 
 extern "C" {
 
-int lt_crt_version() { return 5; }
+int lt_crt_version() { return 6; }
 
 // Engines are single-threaded by contract: one engine = one queue = one
 // dispatch loop. The pipelined era window (native_rt.py) therefore runs ONE
@@ -2255,6 +2318,23 @@ void rt_broadcast_opaque(void* h, int vid, int kind, int agreement, int epoch,
   m->epoch = epoch;
   m->data.assign(reinterpret_cast<const char*>(data), len);
   E->bcast(vid, m);
+}
+
+// Unicast variant: one recipient instead of all n. The adversary layer uses
+// this (with a caller-supplied vid) for per-recipient equivocation and
+// replay — the engine itself never needed unicast opaques before.
+void rt_send_opaque(void* h, int vid, int target, int kind, int agreement,
+                    int epoch, const uint8_t* data, size_t len) {
+  Engine* E = static_cast<Engine*>(h);
+  if (target < 0 || target >= E->n) return;
+  Msg* m = new Msg();
+  m->type = MT_OPAQUE;
+  m->era = E->vals[vid].era;
+  m->opq_kind = (uint8_t)kind;
+  m->agreement = agreement;
+  m->epoch = epoch;
+  m->data.assign(reinterpret_cast<const char*>(data), len);
+  E->sendto(vid, target, m);  // deletes m itself when the sender is muted
 }
 
 size_t rt_run(void* h, size_t max_msgs) {

@@ -36,6 +36,7 @@ XO_ROOT_INPUT = 7
 XO_ROOT_SIGN = 8
 XO_ROOT_VERIFY = 9
 XO_ROOT_PRODUCE = 10
+XO_EVIDENCE = 11
 
 XO_NAMES = {
     XO_COIN_SIGN: "coin_sign",
@@ -48,6 +49,7 @@ XO_NAMES = {
     XO_ROOT_SIGN: "root_sign",
     XO_ROOT_VERIFY: "root_verify",
     XO_ROOT_PRODUCE: "root_produce",
+    XO_EVIDENCE: "evidence",
 }
 
 # Python -> engine post ops
@@ -98,6 +100,7 @@ class CoinHost:
             router.private_keys.ts_share,
             router.public_keys.ts_keys,
         )
+        self._flagged: set = set()  # senders already reported as evidence
 
     def sign(self) -> None:
         # common_coin.py::handle_input — the engine broadcasts + records the
@@ -129,12 +132,16 @@ class CoinHost:
             )
             for (sender, _), pt in zip(pending, pts):
                 if pt is None:
+                    self._flag_invalid(sender)
                     continue  # malformed/bad-subgroup share: drop
                 self._signer.add_share(
                     ts.PartialSignature(sigma=pt, signer_id=sender),
                     verify=False,
                 )
         sig = self._signer.signature
+        # common_coin.py::_try_combine: batch-verifier prunes are evidence
+        for sender in self._signer.pruned - self._flagged:
+            self._flag_invalid(sender)
         if sig is not None:
             self.router._net._rt_post(
                 self.router.my_id,
@@ -143,6 +150,19 @@ class CoinHost:
                 self.cid.epoch,
                 bytes([1 if sig.parity else 0]),
                 era=self.cid.era,
+            )
+
+    def _flag_invalid(self, sender: int) -> None:
+        if sender in self._flagged:
+            return
+        self._flagged.add(sender)
+        ev = getattr(self.router, "evidence", None)
+        if ev is not None:
+            ev.record_invalid_share(
+                self.cid.era,
+                sender,
+                "coin",
+                (self.cid.agreement, self.cid.epoch),
             )
 
 
@@ -352,6 +372,7 @@ class HoneyBadgerHost:
                 failures += 1
                 del self._cands[slot][sender]
                 self._post(PO_HB_REJECT, a=slot, b=sender)
+                self._flag_invalid(sender, slot)
             else:
                 self._parsed[(slot, sender)] = tpke.PartiallyDecryptedShare(
                     ui=pt, decryptor_id=sender, share_id=slot
@@ -379,9 +400,16 @@ class HoneyBadgerHost:
             if not ok:
                 del slot_shares[d.decryptor_id]
                 self._post(PO_HB_REJECT, a=slot, b=d.decryptor_id)
+                self._flag_invalid(d.decryptor_id, slot)
         if len(valid) < need:
             return  # byzantine shares pruned; wait for more
         self._resolve(slot, self._pub.tpke_pub.full_decrypt(ct, valid))
+
+    def _flag_invalid(self, sender: int, slot: int) -> None:
+        # honey_badger.py::_flag_invalid mirror (same record coordinates)
+        ev = getattr(self.router, "evidence", None)
+        if ev is not None:
+            ev.record_invalid_share(self.id.era, sender, "dec", (slot,))
 
     # -- completion (XO_HB_DONE) ----------------------------------------------
     def finish(self) -> dict:
@@ -489,6 +517,10 @@ class RootHost:
                 self.router._net._rt_post(
                     me, PO_ROOT_REJECT, sender, 0, b"", era=era
                 )
+                # root_protocol.py::_on_signed_header ECDSA-reject mirror
+                ev = getattr(self.router, "evidence", None)
+                if ev is not None:
+                    ev.record_invalid_share(era, sender, "hdr", ())
 
     # XO_ROOT_PRODUCE — root_protocol.py::_try_produce
     def on_produce(self):

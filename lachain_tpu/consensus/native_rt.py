@@ -41,6 +41,7 @@ from .native_hosts import (
     XO_COIN_COMBINE,
     XO_COIN_RESULT,
     XO_COIN_SIGN,
+    XO_EVIDENCE,
     XO_HB_ACS,
     XO_HB_DONE,
     XO_HB_QUEUE,
@@ -131,7 +132,7 @@ def load_rt():
             )
     lib = ctypes.CDLL(lib_path)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 5
+    assert lib.lt_crt_version() == 6
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -200,6 +201,16 @@ def load_rt():
     ]
     lib.rt_broadcast_opaque.argtypes = [
         ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.rt_send_opaque.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
         ctypes.c_int,
         ctypes.c_int,
         ctypes.c_int,
@@ -388,6 +399,7 @@ class NativeEraRouter(EraRouter):
         net: "NativeSimulatedNetwork",
         extra_factories=None,
         journal=None,
+        evidence=None,
     ):
         def _no_send(target, payload):  # pragma: no cover
             raise RuntimeError("native router transports via the engine")
@@ -400,6 +412,7 @@ class NativeEraRouter(EraRouter):
             send=_no_send,
             extra_factories=extra_factories,
             journal=journal,
+            evidence=evidence,
         )
         self._net = net
         self._acs_parent: Any = None
@@ -746,6 +759,20 @@ class NativeEraRouter(EraRouter):
             self.root_host(era).on_verify(blob)
         elif op == XO_ROOT_PRODUCE:
             self.root_host(era).on_produce()
+        elif op == XO_EVIDENCE:
+            # engine equivocation latch tripped: a=offender b=opq_kind,
+            # blob = be32(agreement) + be32(epoch). Build the exact record
+            # era.py::_latch_first_seen would (evidence-set identity between
+            # engines is pinned by tests)
+            agreement = int.from_bytes(blob[0:4], "big", signed=True)
+            epoch = int.from_bytes(blob[4:8], "big", signed=True)
+            if b == KIND_DECRYPTED:
+                proto, index = "dec", (agreement,)
+            elif b == KIND_COIN:
+                proto, index = "coin", (agreement, epoch)
+            else:
+                proto, index = "hdr", ()
+            self.evidence.record_equivocation(era, a, proto, index)
         else:  # unknown op: refuse loudly — a silent drop would stall
             raise RuntimeError(f"unknown native crossing op {op}")
 
@@ -1098,6 +1125,24 @@ class NativeSimulatedNetwork:
         if h is not None:
             self._lib.rt_broadcast_opaque(
                 h, vid, kind, agreement, epoch, data, len(data)
+            )
+
+    def _send_opaque(
+        self,
+        vid: int,
+        target: int,
+        kind: int,
+        agreement: int,
+        epoch: int,
+        data: bytes,
+        era: int = None,
+    ) -> None:
+        # unicast opaque injection: the adversary layer's transport (the
+        # caller chooses `vid`, so sender spoofing / replay is expressible)
+        h = self._h_for(era)
+        if h is not None:
+            self._lib.rt_send_opaque(
+                h, vid, target, kind, agreement, epoch, data, len(data)
             )
 
     def _rt_request(self, vid: int, kind: int, a: int, b: int, era: int = None) -> None:

@@ -133,6 +133,9 @@ class RootProtocol(Protocol):
         if not ecdsa.verify_hash(
             self._pubs[sender], self._header.hash(), msg.signature
         ):
+            ev = getattr(self.broadcaster, "evidence", None)
+            if ev is not None:
+                ev.record_invalid_share(self.id.era, sender, "hdr", ())
             return
         self._signatures[sender] = msg.signature
         self._try_produce()

@@ -122,6 +122,19 @@ class SimulatedNetwork:
 
         return send
 
+    def inject(self, sender: int, target: Optional[int], payload) -> None:
+        """Adversary-layer injection: enqueue a payload AS IF `sender` sent
+        it, bypassing the sender's router (and its no-self-equivocation
+        journal latch). target None = broadcast. Keeps the DecryptedMessage
+        flush accounting coherent so the crypto batcher still fires."""
+        if type(payload) is M.DecryptedMessage:
+            self._decrypted_in_queue += self.n if target is None else 1
+        if target is None:
+            for t in range(self.n):
+                self._queue.append((sender, t, payload))
+        else:
+            self._queue.append((sender, target, payload))
+
     # -- adversarial queue ----------------------------------------------------
     def _pop(self) -> Tuple[int, int, Any]:
         if self.mode is DeliveryMode.TAKE_FIRST:

@@ -69,6 +69,7 @@ class Devnet:
         journals: Optional[List] = None,
         exec_lanes: int = 1,
         merkle_workers: int = 1,
+        adversary=None,
     ):
         self.n, self.f = n, f
         self.chain_id = chain_id
@@ -190,6 +191,14 @@ class Devnet:
                 router._extra_factories[M.RootProtocolId] = root_factory_for(
                     self.nodes[i]
                 )
+        # adversary (consensus/adversary.py AdversaryPlan): smart-malicious
+        # traitors with real key shares. Installed AFTER root contexts so a
+        # native traitor's python-override fallback finds its producer seam.
+        self.adversary = adversary
+        if adversary is not None:
+            from ..consensus.adversary import install as install_adversary
+
+            install_adversary(adversary, self.net)
 
     @staticmethod
     def _nonce_reader(state: StateManager):

@@ -124,6 +124,12 @@ class Node:
         from ..consensus.journal import ConsensusJournal
 
         self.journal = ConsensusJournal(self.kv)
+        # durable Byzantine evidence (consensus/evidence.py): persisted on
+        # the node KV before any counter publishes, queryable via
+        # la_getEvidence, survives restart (fsck checks the records)
+        from ..consensus.evidence import EvidenceStore
+
+        self.evidence = EvidenceStore(self.kv)
         self._rejoin_eras: List[int] = []
         self.producer = BlockProducer(
             self.block_manager,
@@ -789,6 +795,7 @@ class Node:
                 self._transport_send,
                 extra_factories={M.RootProtocolId: self._root_factory},
                 journal=self.journal,
+                evidence=self.evidence,
             )
             self.router.pipeline_window = self.pipeline_window
         else:

@@ -348,6 +348,9 @@ class ThresholdSigner:
         self.pub_key_set = pub_key_set
         self._shares: Dict[int, PartialSignature] = {}
         self._signature: Optional[Signature] = None
+        # signer ids whose shares failed the deferred batch verification —
+        # Byzantine evidence the owning protocol surfaces (evidence.py)
+        self.pruned: set = set()
 
     def sign(self) -> PartialSignature:
         return self.key_share.sign(self.msg)
@@ -373,6 +376,9 @@ class ThresholdSigner:
                 # invalid shares so they cannot poison every later combine.
                 held = list(self._shares.values())
                 oks = self.pub_key_set.batch_verify_shares(self.msg, held)
+                self.pruned.update(
+                    s.signer_id for s, ok in zip(held, oks) if not ok
+                )
                 self._shares = {
                     s.signer_id: s for s, ok in zip(held, oks) if ok
                 }

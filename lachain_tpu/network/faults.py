@@ -276,6 +276,78 @@ class TcpFrameFilter:
         return [0.0]
 
 
+class AdversarialRelayFilter:
+    """Hub frame filter modelling a MALICIOUS relay rather than a lossy
+    link: the node it is installed on selectively forwards, reorders
+    (delays), and replays the signed batch frames it emits. Decisions are
+    a pure seeded hash of the frame bytes — two runs replay the identical
+    attack (the same determinism contract as FaultPlan and
+    consensus/adversary.py). Because frames carry batch signatures, honest
+    receivers absorb every replay via signature checks + dedupe, and
+    selective forwarding is repaired by the outbox-replay layer; the
+    chaos and adversary suites pin that. Composes with an inner filter.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        drop_rate: int = 8,  # silently eat 1-in-N frames
+        replay_rate: int = 8,  # send 1-in-N frames twice
+        reorder_rate: int = 8,  # delay 1-in-N frames by `delay_s`
+        delay_s: float = 0.05,
+        inner=None,
+    ):
+        self.seed = seed
+        self.drop_rate = drop_rate
+        self.replay_rate = replay_rate
+        self.reorder_rate = reorder_rate
+        self.delay_s = delay_s
+        self.inner = inner
+        self.stats = {"forwarded": 0, "dropped": 0, "replayed": 0,
+                      "reordered": 0}
+
+    def _h(self, tag: bytes, data: bytes) -> int:
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+        h.update(str(self.seed).encode())
+        h.update(tag)
+        h.update(data)
+        return int.from_bytes(h.digest(), "big")
+
+    def outbound(self, peer, data: bytes) -> List[float]:
+        if self.inner is not None and not self.inner.outbound(peer, data):
+            return []
+        if self.drop_rate and self._h(b"drop", data) % self.drop_rate == 0:
+            self.stats["dropped"] += 1
+            metrics.inc(
+                "fault_injected_total", labels={"action": "relay_drop"}
+            )
+            return []
+        if self.replay_rate and self._h(b"dup", data) % self.replay_rate == 0:
+            self.stats["replayed"] += 1
+            metrics.inc(
+                "fault_injected_total", labels={"action": "relay_replay"}
+            )
+            return [0.0, 0.0]
+        if (
+            self.reorder_rate
+            and self._h(b"ord", data) % self.reorder_rate == 0
+        ):
+            self.stats["reordered"] += 1
+            metrics.inc(
+                "fault_injected_total", labels={"action": "relay_reorder"}
+            )
+            return [self.delay_s]
+        self.stats["forwarded"] += 1
+        return [0.0]
+
+    def inbound(self, data: bytes) -> List[float]:
+        if self.inner is not None:
+            return self.inner.inbound(data)
+        return [0.0]
+
+
 class KillSwitch:
     """Hub frame filter that makes a node go dark on command.
 

@@ -615,6 +615,19 @@ class RpcService:
         and the fleet-trace merger."""
         return self.node.health()
 
+    def la_getEvidence(self, era=None):
+        """Byzantine evidence records this node has detected and persisted
+        (consensus/evidence.py): equivocations (conflicting payloads from
+        one sender in one protocol slot) and invalid shares (signature /
+        point / subgroup check failures). Deduped, durably stored BEFORE
+        the counters publish, so a restart never loses an accusation.
+        Optional `era` filters to one era; records are sorted."""
+        if era is not None:
+            era = int(era, 16) if isinstance(era, str) else int(era)
+        ev = getattr(self.node, "evidence", None)
+        records = ev.snapshot(era) if ev is not None else []
+        return {"count": len(records), "records": records}
+
     def la_getTraceSummary(self):
         """Per-span-name aggregate of the trace ring buffer:
         {name: {count, total_ms, max_ms, open}}."""

@@ -317,6 +317,34 @@ def fsck(
             )
         )
 
+    # Byzantine evidence records (consensus/evidence.py): malformed keys or
+    # undecodable values are repairable garbage — an accusation that cannot
+    # be decoded cannot be served and must not wedge la_getEvidence
+    report.checked.append("evidence")
+    from ..consensus.evidence import EvidenceRecord
+
+    ev_prefix = prefixed(EntryPrefix.EVIDENCE)
+    bad_ev = []
+    for key, value in kv.scan_prefix(ev_prefix):
+        if len(key) != len(ev_prefix) + 8:
+            bad_ev.append(key)
+            continue
+        try:
+            EvidenceRecord.decode(value)
+        except Exception:
+            bad_ev.append(key)
+    if bad_ev:
+        if repair:
+            kv.write_batch([], bad_ev)
+        report.issues.append(
+            FsckIssue(
+                code="evidence-decode",
+                severity=repairable,
+                detail=f"{len(bad_ev)} undecodable evidence records",
+                repair="dropped" if repair else None,
+            )
+        )
+
     # pool repository: undecodable entries
     report.checked.append("pool")
     from ..core.types import SignedTransaction

@@ -45,6 +45,10 @@ class EntryPrefix(enum.IntEnum):
     # on 100k+-node tries. Transient: deleted on sync completion; leftover
     # rows after a mid-sync crash are repairable garbage (fsck prunes them)
     FASTSYNC_FRONTIER = 0x0B01
+    # Byzantine evidence records (consensus/evidence.py): durable, deduped
+    # accusations (equivocation / invalid shares) that must survive restart —
+    # an offense detected pre-crash stays queryable via la_getEvidence
+    EVIDENCE = 0x0C01
 
 
 def prefixed(prefix: EntryPrefix, key: bytes = b"") -> bytes:

@@ -852,6 +852,20 @@ def era_report(
                 },
             }
         )
+    # Byzantine pressure per era (evidence.py per-process registry): how
+    # many NEW equivocation / invalid-share records this process minted
+    # while the era ran — `trace --era-report` surfaces attack visibility
+    # next to the phase timings it distorts
+    try:
+        from ..consensus.evidence import era_counts
+
+        by_era = era_counts()
+        for ent in eras:
+            ent["byzantine"] = dict(
+                by_era.get(ent["era"], {"equivocation": 0, "invalid_share": 0})
+            )
+    except Exception:
+        pass  # evidence module must never break the report
     return {"eras": eras, "phases": list(PHASES)}
 
 
@@ -862,12 +876,13 @@ def era_report_table(report: Optional[dict] = None) -> str:
     cols = (
         ["era", "wall_s"] + list(PHASES)
         + ["idle_s"] + [f"w:{r}" for r in WAIT_RESOURCES]
-        + ["unattr_s", "overlap_s", "dev_util"]
+        + ["unattr_s", "overlap_s", "dev_util", "equiv", "badshare"]
     )
     rows = [cols]
     for ent in report["eras"]:
         dev = ent.get("device") or {}
         waits = ent.get("waits_s") or {}
+        byz = ent.get("byzantine") or {}
         rows.append(
             [str(ent["era"]), f"{ent['wall_s']:.3f}"]
             + [f"{ent['phases_s'][p]:.3f}" for p in PHASES]
@@ -877,6 +892,8 @@ def era_report_table(report: Optional[dict] = None) -> str:
                 f"{ent.get('idle_unattributed_s', 0.0):.3f}",
                 f"{ent.get('overlap_s', 0.0):.3f}",
                 f"{dev.get('util', 0.0):.3f}",
+                str(byz.get("equivocation", 0)),
+                str(byz.get("invalid_share", 0)),
             ]
         )
     if len(rows) == 1:

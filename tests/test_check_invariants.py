@@ -399,6 +399,61 @@ def test_metric_names_with_suffix_and_gauges_are_clean(tmp_path, capsys):
     assert rc == 0, out
 
 
+def test_evidence_counter_minted_outside_evidence_module(tmp_path, capsys):
+    # the evidence counters imply "a record is on disk"; a module bumping
+    # them directly would break that contract even with correct values
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evil_evidence.py": """
+            from ..utils import metrics
+
+            def convict(sender):
+                metrics.inc(
+                    "consensus_equivocations_total", labels={"proto": "coin"}
+                )
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[evidence-durability]" in out
+    assert "outside consensus/evidence.py" in out
+
+
+def test_evidence_count_before_persist_is_flagged(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evidence.py": """
+            from ..utils import metrics
+
+            class EvidenceStore:
+                def _record(self, rec, metric):
+                    metrics.inc(metric, labels={"proto": rec.proto})
+                    self._persist(rec)
+        """,
+    }, capsys)
+    assert rc == 1
+    assert "[evidence-durability]" in out
+    assert "before the record is persisted" in out
+
+
+def test_evidence_persist_then_count_is_clean(tmp_path, capsys):
+    rc, out, _ = run_lint(tmp_path, {
+        "consensus/evidence.py": """
+            from ..utils import metrics
+
+            class EvidenceStore:
+                def _record(self, rec, metric):
+                    if self._full():
+                        # shed records are deliberately NOT persisted; the
+                        # constant-name drop counter is exempt from the
+                        # dominance rule
+                        metrics.inc("consensus_evidence_dropped_total")
+                        return False
+                    self._persist(rec)
+                    metrics.inc(metric, labels={"proto": rec.proto})
+                    return True
+        """,
+    }, capsys)
+    assert rc == 0, out
+
+
 def test_metric_name_lint_allow_escape(tmp_path, capsys):
     rc, out, err = run_lint(tmp_path, {
         "rpc/allowed_metrics.py": """

@@ -1,0 +1,438 @@
+"""Smart-malicious adversary fleet: dual-engine verdict identity.
+
+The Byzantine tests in test_consensus_byzantine.py subclass a protocol to
+misbehave; this suite drives the pluggable strategy layer
+(consensus/adversary.py) instead — traitors with REAL key shares that
+equivocate, withhold at the threshold boundary, replay captured frames,
+and flood junk shares. The properties pinned here:
+
+  * identity — every scenario commits the same block hashes AND files the
+    same evidence set on the pure-Python protocols and the native engine
+    (the Python protocols are the oracle; the C++ opq_latch must convict
+    the exact same offenders);
+  * determinism — two runs of the same plan are bit-identical (hashes,
+    delivered counts, evidence), so a recorded adversarial incident
+    replays from its seed;
+  * bounded memory — the spam flooder is absorbed by the per-sender
+    first-seen latch caps, shedding (counted) instead of growing;
+  * durability — evidence records survive process death via the kv
+    journal path and fsck treats undecodable ones as repairable garbage.
+
+Marked `byzantine` (and `chaos`: full devnet eras with real threshold
+crypto).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lachain_tpu.consensus.adversary import STRATEGIES, AdversaryPlan
+from lachain_tpu.consensus.evidence import (
+    EQUIVOCATION,
+    EvidenceStore,
+    era_counts,
+)
+from lachain_tpu.core.devnet import Devnet
+from lachain_tpu.network.faults import Crash, FaultPlan
+from lachain_tpu.storage.fsck import fsck
+from lachain_tpu.storage.kv import EntryPrefix, SqliteKV, prefixed
+from lachain_tpu.utils import metrics
+
+pytestmark = [pytest.mark.byzantine, pytest.mark.chaos]
+
+# the strategies every engine can express; equivocate_votes is
+# python-protocols-only (BB messages are engine-typed natively) and gets
+# its own test below
+DUAL_ENGINE_STRATEGIES = ("equivocate", "withhold", "relay", "spam")
+
+
+def _native_or_skip():
+    from lachain_tpu.consensus.native_rt import load_rt
+
+    try:
+        load_rt()
+    except Exception:
+        pytest.skip("native engine not built")
+
+
+def _run_campaign(strategy, engine, *, n=7, f=2, eras=2, seed=9,
+                  traitors=(1, 3), adv_seed=5, fault_plan=None):
+    plan = AdversaryPlan(strategy=strategy, traitors=traitors, seed=adv_seed)
+    d = Devnet(
+        n=n, f=f, seed=seed, engine=engine, adversary=plan,
+        fault_plan=fault_plan,
+    )
+    blocks = d.run_eras(1, eras)
+    honest = [i for i in range(n) if i not in set(traitors)]
+    evidence = {
+        i: d.net.routers[i].evidence.record_set() for i in honest
+    }
+    return d, [b.hash() for b in blocks], evidence
+
+
+# ---------------------------------------------------------------------------
+# tentpole: dual-engine verdict identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("strategy", DUAL_ENGINE_STRATEGIES)
+def test_dual_engine_verdict_identity(strategy):
+    """Same adversary plan on python protocols and the native engine:
+    identical committed block hashes and identical evidence sets at every
+    honest node. Detection verdicts are consensus-critical state — an
+    engine that convicts different offenders has forked the accusation
+    layer even if the chain agrees."""
+    _native_or_skip()
+    _, h_py, ev_py = _run_campaign(strategy, "python")
+    _, h_nat, ev_nat = _run_campaign(strategy, "native")
+    assert h_py == h_nat, f"{strategy}: block-hash divergence across engines"
+    assert ev_py == ev_nat, f"{strategy}: evidence divergence across engines"
+    all_records = set().union(*ev_py.values())
+    if strategy == "equivocate":
+        # both traitors convicted of equivocation at every honest node
+        for i, recs in ev_py.items():
+            assert {r.offender for r in recs} == {1, 3}, (strategy, i)
+            assert all(r.kind == EQUIVOCATION for r in recs)
+    else:
+        # withhold/relay/spam are TOLERATED (absorbed, not evidenced):
+        # withholding is indistinguishable from loss, replayed frames
+        # dedupe, junk shares never reach a combine
+        assert all_records == set(), (strategy, all_records)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ("equivocate", "spam"))
+def test_dual_engine_verdict_identity_n10_f3(strategy):
+    """The identity lock holds at the larger quorum too: N=10/f=3 with
+    f smart-malicious validators, liveness plus identical verdicts."""
+    _native_or_skip()
+    traitors = (1, 4, 7)
+    _, h_py, ev_py = _run_campaign(
+        strategy, "python", n=10, f=3, traitors=traitors
+    )
+    _, h_nat, ev_nat = _run_campaign(
+        strategy, "native", n=10, f=3, traitors=traitors
+    )
+    assert h_py == h_nat
+    assert ev_py == ev_nat
+    if strategy == "equivocate":
+        for recs in ev_py.values():
+            assert {r.offender for r in recs} == set(traitors)
+
+
+@pytest.mark.parametrize("strategy", ("equivocate", "relay"))
+def test_two_runs_bit_identical(strategy):
+    """Seeded adversary: the full transcript — block hashes, delivered
+    message count, evidence — is reproducible run over run."""
+    runs = []
+    for _ in range(2):
+        d, hashes, evidence = _run_campaign(strategy, "python")
+        runs.append((hashes, d.net.delivered_count, evidence))
+    assert runs[0] == runs[1]
+
+
+def test_equivocate_votes_python_only():
+    """Vote-flip equivocation (AUX/CONF) runs on the python protocols and
+    is convicted there; the native engine cannot host it (BB messages are
+    engine-typed) and must refuse loudly rather than silently not attack."""
+    d, hashes, evidence = _run_campaign(
+        "equivocate_votes", "python", eras=1, traitors=(2,)
+    )
+    assert len(hashes) == 1
+    for i, recs in evidence.items():
+        assert {r.offender for r in recs} == {2}, (i, recs)
+
+    from lachain_tpu.consensus.native_rt import load_rt
+
+    try:
+        load_rt()
+    except Exception:
+        pytest.skip("native engine not built")
+    with pytest.raises(ValueError, match="equivocate_votes"):
+        Devnet(
+            n=4, f=1, seed=3, engine="native",
+            adversary=AdversaryPlan(
+                strategy="equivocate_votes", traitors=(1,)
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# spam: bounded buffers, counted shedding
+# ---------------------------------------------------------------------------
+
+
+def test_spam_is_shed_not_buffered():
+    """The share-spam flooder pushes thousands of distinct junk coin slots
+    per traitor. The per-sender first-seen latch cap must shed the excess
+    (counted) so honest memory stays bounded, the chain stays live, and —
+    because junk slots never reach a combine — no evidence is filed."""
+    base = metrics.counter_value(
+        "consensus_msgs_shed_total", labels={"reason": "latch_cap"}
+    )
+    d, hashes, evidence = _run_campaign("spam", "python", eras=1)
+    assert len(hashes) == 1
+    shed = metrics.counter_value(
+        "consensus_msgs_shed_total", labels={"reason": "latch_cap"}
+    ) - base
+    assert shed > 0, "flood never hit the latch cap"
+    for i, recs in evidence.items():
+        assert recs == frozenset()
+        router = d.net.routers[i]
+        cap = router.first_seen_sender_cap
+        for sender, count in router._first_seen_per_sender.items():
+            assert count <= cap, (i, sender, count)
+
+
+# ---------------------------------------------------------------------------
+# evidence durability: kv round-trip, restart dedup, fsck
+# ---------------------------------------------------------------------------
+
+
+def test_evidence_store_persists_and_reloads(tmp_path):
+    kv = SqliteKV(str(tmp_path / "ev.db"))
+    try:
+        s1 = EvidenceStore(kv)
+        assert s1.record_equivocation(1, 3, "coin", (0, 2))
+        assert s1.record_equivocation(1, 3, "coin", (-1, 0))  # nonce coin
+        assert s1.record_invalid_share(2, 5, "dec", (4,))
+        # duplicate accusation: not a new record, not re-persisted
+        assert not s1.record_equivocation(1, 3, "coin", (0, 2))
+        assert len(s1) == 3
+
+        # "restart": a fresh store over the same kv sees the same records
+        s2 = EvidenceStore(kv)
+        assert s2.record_set() == s1.record_set()
+        assert s2.record_set(era=1) == s1.record_set(era=1)
+        # ...and still dedups accusations made before the crash
+        assert not s2.record_equivocation(1, 3, "coin", (0, 2))
+        assert len(s2) == 3
+        # the queryable snapshot round-trips the signed nonce-coin index
+        assert any(
+            rec["index"] == [-1, 0] for rec in s2.snapshot(era=1)
+        )
+    finally:
+        kv.close()
+
+
+def test_fsck_repairs_torn_evidence(tmp_path):
+    kv = SqliteKV(str(tmp_path / "ev.db"))
+    try:
+        store = EvidenceStore(kv)
+        store.record_equivocation(1, 3, "coin", (0, 0))
+        # a torn write: garbage value under a well-formed key, plus a
+        # malformed key in the evidence keyspace
+        kv.write_batch([
+            (prefixed(EntryPrefix.EVIDENCE, (99).to_bytes(8, "big")),
+             b"\xff\xff not a record"),
+            (prefixed(EntryPrefix.EVIDENCE, b"short"), b"x"),
+        ])
+        report = fsck(kv, repair=True)
+        assert not report.fatal
+        assert any(i.code == "evidence-decode" for i in report.repaired)
+        # the repaired store serves the surviving record and nothing else
+        s2 = EvidenceStore(kv)
+        assert len(s2) == 1
+        assert fsck(kv, repair=False).clean
+    finally:
+        kv.close()
+
+
+def test_la_get_evidence_rpc_shape():
+    from lachain_tpu.rpc.service import RpcService
+
+    class _Node:
+        evidence = EvidenceStore()
+
+    _Node.evidence.record_equivocation(1, 3, "coin", (0, 2))
+    _Node.evidence.record_invalid_share(2, 5, "dec", (4,))
+    svc = RpcService(node=_Node())
+    out = svc.la_getEvidence()
+    assert out["count"] == 2
+    assert {r["kind"] for r in out["records"]} == {
+        "equivocation", "invalid_share"
+    }
+    # era filter, hex-coded era (the eth-style convention)
+    out1 = svc.la_getEvidence("0x1")
+    assert out1["count"] == 1
+    rec = out1["records"][0]
+    assert rec == {
+        "era": 1, "kind": "equivocation", "offender": 3,
+        "proto": "coin", "index": [0, 2],
+    }
+
+
+# ---------------------------------------------------------------------------
+# composed slow campaign: loss + traitor + mid-campaign SIGKILL
+# ---------------------------------------------------------------------------
+
+_CHILD_SCRIPT = r"""
+import json, sys
+from lachain_tpu.consensus.adversary import AdversaryPlan
+from lachain_tpu.consensus.evidence import EvidenceStore
+from lachain_tpu.core.devnet import Devnet
+from lachain_tpu.network.faults import FaultPlan
+from lachain_tpu.storage.kv import SqliteKV
+
+outdir = sys.argv[1]
+kvs = {}
+
+def kv_factory(i):
+    kvs[i] = SqliteKV(f"{outdir}/v{i}.db")
+    return kvs[i]
+
+d = Devnet(
+    n=7, f=2, seed=9,
+    fault_plan=FaultPlan(seed=7, drop=0.05, duplicate=0.03),
+    adversary=AdversaryPlan(strategy="equivocate", traitors=(1, 3), seed=5),
+    kv_factory=kv_factory,
+)
+# route each honest router's evidence into its node's durable store so
+# the accusations are on disk when the parent SIGKILLs us
+for i, router in enumerate(d.net.routers):
+    router.evidence = EvidenceStore(kvs[i])
+for era in range(1, 100):
+    d.run_era(era)
+    print(json.dumps({"era": era}), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_composed_campaign_survives_loss_traitors_and_sigkill(tmp_path):
+    """The composed worst day, in two halves.
+
+    (1) Determinism under composition: seeded message loss + a scheduled
+    crash/restart window + two equivocating smart-malicious validators,
+    run twice — bit-identical block hashes, delivered counts and evidence
+    sets (the traitors are convicted both times, identically).
+
+    (2) Real process death: the same loss+traitor campaign runs on
+    durable per-node stores in a subprocess that is SIGKILLed mid-
+    campaign (no shutdown hooks). Every surviving database must fsck
+    clean-or-repaired, and the honest nodes' on-disk evidence must
+    already convict the traitors."""
+    plan = FaultPlan(
+        seed=7, drop=0.05, duplicate=0.03,
+        crashes=(Crash(node=5, at=80, restart=600),),
+    )
+    runs = []
+    for _ in range(2):
+        d, hashes, evidence = _run_campaign(
+            "equivocate", "python", fault_plan=plan, eras=2
+        )
+        runs.append((hashes, d.net.delivered_count, evidence))
+    assert runs[0] == runs[1]
+    assert runs[0][2], "campaign filed no evidence"
+    for recs in runs[0][2].values():
+        assert {r.offender for r in recs} == {1, 3}
+
+    # -- half 2: SIGKILL a real process mid-campaign ------------------------
+    outdir = tmp_path / "stores"
+    outdir.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", LOG_LEVEL="WARNING")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SCRIPT, str(outdir)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        # wait until at least one era has committed, then kill mid-flight
+        line = None
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line:
+                break
+        assert line and json.loads(line)["era"] >= 1, (
+            "campaign child never committed an era"
+        )
+        time.sleep(0.3)  # let era 2 get airborne
+    finally:
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGKILL
+
+    honest = [i for i in range(7) if i not in (1, 3)]
+    convictions = {}
+    for i in range(7):
+        kv = SqliteKV(str(outdir / f"v{i}.db"))
+        try:
+            report = fsck(kv, repair=True)
+            assert not report.fatal, (i, report.to_dict())
+            if i in honest:
+                convictions[i] = {
+                    r.offender for r in EvidenceStore(kv).records()
+                }
+        finally:
+            kv.close()
+    # evidence persisted BEFORE it was counted: the killed process's
+    # honest stores already hold the era-1 convictions
+    for i, offenders in convictions.items():
+        assert offenders == {1, 3}, (i, offenders)
+
+
+# ---------------------------------------------------------------------------
+# era report surfaces the pressure
+# ---------------------------------------------------------------------------
+
+
+def test_era_counts_surface_in_report():
+    """era_counts() feeds the trace era report's byzantine columns: an
+    equivocation campaign must show up as per-era pressure."""
+    from lachain_tpu.consensus.evidence import reset_era_counts
+
+    reset_era_counts()
+    _run_campaign("equivocate", "python", eras=2)
+    counts = era_counts()
+    assert counts.get(1, {}).get("equivocation", 0) > 0
+    assert counts.get(2, {}).get("equivocation", 0) > 0
+
+
+def test_adversarial_relay_filter_is_seeded_and_composes():
+    """The TCP-hub leg of the adversarial relay: seeded per-frame
+    forward/drop/replay/reorder decisions over the hub's delay-plan API,
+    bit-identical across filter instances, composing with an inner
+    filter the way KillSwitch does."""
+    from lachain_tpu.network.faults import AdversarialRelayFilter
+
+    frames = [b"frame-%d" % i for i in range(256)]
+    a = AdversarialRelayFilter(seed=3)
+    b = AdversarialRelayFilter(seed=3)
+    plans_a = [a.outbound(("h", 1), fr) for fr in frames]
+    plans_b = [b.outbound(("h", 1), fr) for fr in frames]
+    assert plans_a == plans_b and a.stats == b.stats
+    # all four behaviours occur: [] drop, [0] forward, [0,0] replay,
+    # [delay] reorder
+    assert a.stats["dropped"] > 0 and a.stats["replayed"] > 0
+    assert a.stats["reordered"] > 0 and a.stats["forwarded"] > 0
+    assert [] in plans_a and [0.0] in plans_a and [0.0, 0.0] in plans_a
+    assert [a.delay_s] in plans_a
+    # a different seed makes different decisions
+    c = AdversarialRelayFilter(seed=4)
+    assert [c.outbound(("h", 1), fr) for fr in frames] != plans_a
+
+    # inner-filter composition: a dead inner (KillSwitch idiom) vetoes
+    # everything; inbound delegates
+    class DeadInner:
+        def outbound(self, peer, data):
+            return []
+
+        def inbound(self, data):
+            return []
+
+    d = AdversarialRelayFilter(seed=3, inner=DeadInner())
+    assert all(d.outbound(("h", 1), fr) == [] for fr in frames)
+    assert d.inbound(b"x") == []
+    assert AdversarialRelayFilter(seed=3).inbound(b"x") == [0.0]
+
+
+def test_plan_validation():
+    assert set(DUAL_ENGINE_STRATEGIES) < set(STRATEGIES)
+    with pytest.raises(ValueError):
+        AdversaryPlan(strategy="nope", traitors=(0,))
+    plan = AdversaryPlan(strategy="spam", traitors=[2])
+    assert plan.traitors == (2,)

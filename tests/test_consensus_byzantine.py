@@ -12,10 +12,14 @@ from lachain_tpu.crypto import bls12381 as bls
 from lachain_tpu.crypto import tpke
 from lachain_tpu.consensus import messages as M
 from lachain_tpu.consensus.era import EraRouter
+from lachain_tpu.consensus.evidence import INVALID_SHARE
 from lachain_tpu.consensus.honey_badger import HoneyBadger
 from lachain_tpu.consensus.simulator import DeliveryMode, SimulatedNetwork
+from lachain_tpu.utils import metrics
 
 from tests.test_consensus import keys_for
+
+pytestmark = pytest.mark.byzantine
 
 
 class MaliciousHoneyBadger(HoneyBadger):
@@ -80,19 +84,37 @@ def _run_with_malicious(n, f, n_malicious, seed):
         return all(net.routers[i].result_of(pid) is not None for i in honest)
 
     assert net.run(done)
-    return [net.routers[i].result_of(pid) for i in honest]
+    return net, [net.routers[i].result_of(pid) for i in honest]
 
 
 @pytest.mark.parametrize("n,f,bad", [(4, 1, 1), (7, 2, 2)])
 def test_honey_badger_malicious_shares(n, f, bad):
     """Corrupted decryption shares are detected by batched verification and
     honest nodes still agree and decrypt (HoneyBadgerTest.SetUpOneMalicious
-    shape)."""
-    results = _run_with_malicious(n, f, bad, seed=21)
+    shape). Detection is no longer silent: every honest router files an
+    invalid-share evidence record against each corrupt sender and the
+    consensus_invalid_shares_total counter advances."""
+    base = metrics.counter_value(
+        "consensus_invalid_shares_total", labels={"proto": "dec"}
+    )
+    net, results = _run_with_malicious(n, f, bad, seed=21)
     assert all(r == results[0] for r in results)
     assert len(results[0]) >= n - f
     for j, pt in results[0].items():
         assert pt == b"tx|%d" % j
+
+    # every honest router convicted every malicious sender, on the dec slots
+    for i in range(bad, n):
+        ev = net.routers[i].evidence
+        offenders = {r.offender for r in ev.records(era=0)}
+        assert offenders == set(range(bad)), (i, offenders)
+        for rec in ev.records(era=0):
+            assert rec.kind == INVALID_SHARE
+            assert rec.proto == "dec"
+    grew = metrics.counter_value(
+        "consensus_invalid_shares_total", labels={"proto": "dec"}
+    ) - base
+    assert grew >= (n - bad) * bad
 
 
 def test_rbc_equivocating_sender():

@@ -36,6 +36,17 @@ P. **Persist-before-transmit** — in `consensus/`, a raw transport send
    that REPLAY already-journaled bytes are whitelisted below, with the
    reason recorded next to the name.
 
+E. **Evidence durability** — the Byzantine-evidence counters
+   (`consensus_equivocations_total`, `consensus_invalid_shares_total`) may
+   only be incremented by `consensus/evidence.py`: the EvidenceStore is the
+   single mint site because it persists the record (kv `write_batch` via
+   `_persist`) BEFORE counting it, so a crash between persist and scrape
+   under-counts but never reports evidence that is not on disk. Inside
+   evidence.py the dominance is checked the same way as rule P: the
+   dynamic-name `metrics.inc(metric, ...)` (the kind-mapped evidence
+   counter) must appear on a later line than a `_persist`/`write_batch`
+   call in the same function.
+
 M. **Metric-name hygiene** — counters and histograms minted through
    `utils.metrics` (`inc` / `observe_hist` / `histogram`) must end in
    `_total`, `_seconds` or `_bytes`; point-in-time gauges go through
@@ -634,6 +645,107 @@ def check_persist_before_transmit(
     return out
 
 
+# -- rule E: evidence durability ---------------------------------------------
+
+EVIDENCE_MODULE = "consensus/evidence.py"
+EVIDENCE_COUNTERS = (
+    "consensus_equivocations_total",
+    "consensus_invalid_shares_total",
+)
+EVIDENCE_PERSIST_CALLEES = ("_persist", "write_batch")
+
+
+def _metrics_inc_name_node(node: ast.AST) -> Optional[ast.AST]:
+    """metrics.inc(...) / _metrics.inc(...) -> the name argument node."""
+    if not isinstance(node, ast.Call) or not isinstance(
+        node.func, ast.Attribute
+    ):
+        return None
+    if node.func.attr != "inc":
+        return None
+    base = _dotted(node.func.value)
+    if base is None or base.split(".")[-1] not in ("metrics", "_metrics"):
+        return None
+    name_node: Optional[ast.AST] = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "name":
+            name_node = kw.value
+    return name_node
+
+
+def check_evidence_durability(
+    relpath: str, rel_in_pkg: str, tree: ast.Module, src_lines: List[str]
+) -> List[Violation]:
+    out: List[Violation] = []
+
+    if rel_in_pkg != EVIDENCE_MODULE:
+        # prong 1: nobody else mints the evidence counters
+        for node in ast.walk(tree):
+            name_node = _metrics_inc_name_node(node)
+            if (
+                isinstance(name_node, ast.Constant)
+                and name_node.value in EVIDENCE_COUNTERS
+            ):
+                if _line_allowed(
+                    src_lines, node.lineno, "evidence-durability"
+                ):
+                    continue
+                out.append(Violation(
+                    relpath, node.lineno, "evidence-durability",
+                    f"evidence counter {name_node.value!r} incremented "
+                    "outside consensus/evidence.py — only EvidenceStore "
+                    "may count evidence (it persists the record first)",
+                ))
+        return out
+
+    # prong 2: inside evidence.py, a dynamic-name inc (the kind-mapped
+    # evidence counter) must be dominated by a persist call in the same
+    # function. Constant-name counters (the drop counter for shed records
+    # that are deliberately NOT persisted) are exempt.
+    def scan_fn(fn) -> None:
+        persist_lines: List[int] = []
+        incs: List[int] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee in EVIDENCE_PERSIST_CALLEES:
+                persist_lines.append(node.lineno)
+            name_node = _metrics_inc_name_node(node)
+            if name_node is not None and not isinstance(
+                name_node, ast.Constant
+            ):
+                incs.append(node.lineno)
+        if not incs:
+            return
+        first_persist = min(persist_lines) if persist_lines else None
+        for line in incs:
+            if _line_allowed(src_lines, line, "evidence-durability"):
+                continue
+            if first_persist is None or line < first_persist:
+                out.append(Violation(
+                    relpath, line, "evidence-durability",
+                    "evidence counter incremented before the record is "
+                    "persisted (_persist/write_batch must dominate "
+                    "metrics.inc)",
+                ))
+
+    def walk(body) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_fn(node)
+                walk(node.body)
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body)
+
+    walk(tree.body)
+    return out
+
+
 # -- rule M: metric-name hygiene ---------------------------------------------
 
 METRIC_SUFFIXES = ("_total", "_seconds", "_bytes")
@@ -742,6 +854,9 @@ def run(root: str) -> int:
             violations += check_persist_before_transmit(
                 relpath, tree, src_lines
             )
+        violations += check_evidence_durability(
+            relpath, rel_in_pkg, tree, src_lines
+        )
         if rel_in_pkg != "utils/metrics.py":
             # the registry's own plumbing (render_text's fold cell, the
             # drop counter) is not a mint site
