@@ -9,7 +9,7 @@ PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-byzantine test-storage \
 	test-observability test-sync test-pipeline test-exec test-trie \
-	test-mesh native bench bench-gate lint sanitize sanitize-tsan
+	test-mesh test-wan native bench bench-gate lint sanitize sanitize-tsan
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend.
@@ -23,13 +23,13 @@ test-kernel:
 # consensus, storage, network, RPC, node lifecycle — the quick sanity
 # slice to run after most changes
 test-fast:
-	$(PYTEST) $(PYTEST_ARGS) -m "not kernel and not chaos and not crash and not slow"
+	$(PYTEST) $(PYTEST_ARGS) -m "not kernel and not chaos and not crash and not slow and not wan"
 
 # fault injection + durability: seeded loss/partition chaos matrices,
 # crash-point injection, SIGKILL-restart recovery ("not mesh": the
 # slow-marked mesh differentials run in their own job, not here)
 test-chaos:
-	$(PYTEST) $(PYTEST_ARGS) -m "(chaos or crash or slow) and not mesh"
+	$(PYTEST) $(PYTEST_ARGS) -m "(chaos or crash or slow) and not mesh and not wan"
 
 # smart-malicious adversaries: the strategy fleet (equivocate/withhold/
 # relay/spam/vote-flip), dual-engine verdict identity, evidence
@@ -95,6 +95,14 @@ test-mesh:
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PYTEST) $(PYTEST_ARGS) -m mesh
 
+# WAN survival: link-shaper determinism + unit surface, RTT-adaptive
+# recovery, the versioned-wire handshake/downgrade interop, and the
+# rolling-upgrade drill (slow-marked legs included). The slice to run
+# after touching network/faults.py LinkShaper, network/rtt.py,
+# network/wire.py versioning, or core/fleet.py
+test-wan:
+	$(PYTEST) $(PYTEST_ARGS) -m wan
+
 test:
 	$(PYTEST) $(PYTEST_ARGS)
 
@@ -155,3 +163,7 @@ bench-gate:
 		--mesh-devices 8 | tail -n 1 > /tmp/lachain_mesh_now.json
 	python benchmarks/compare.py benchmarks/MULTICHIP_sim_gate.json \
 		/tmp/lachain_mesh_now.json --min-threshold-pct 60
+	python benchmarks/bench_wan_sim.py --n 4 --eras 3 \
+		| tail -n 1 > /tmp/lachain_wan_now.json
+	python benchmarks/compare.py benchmarks/BENCH_wan_gate.json \
+		/tmp/lachain_wan_now.json --min-threshold-pct 60

@@ -1,0 +1,184 @@
+"""WAN survival curve: era commit latency vs emulated link RTT.
+
+Boots the in-process loopback TCP fleet (core/fleet.TcpFleet — full
+nodes, signed batches, real sockets) once per LinkShaper point and runs
+a few traffic-paced eras at each, recording the era-latency-vs-RTT curve
+the DEPLOY.md WAN runbook promises. Emits ONE JSON line shaped for
+benchmarks/compare.py: the headline value (and era_latency_p99_s) is the
+era p99 at the STEEPEST shaped point, rtt_ms the SRTT observed there.
+
+Self-gate (exit 1): degradation must stay sub-linear in RTT — the era
+p99 may grow by at most --max-rtt-slope sequential RTTs over the
+unshaped baseline. HoneyBadgerBFT commits in a bounded number of
+protocol rounds, so a healthy fleet's slope is small; a slope past the
+bound means timeouts/retransmits are compounding (the RTT-adaptive
+recovery this curve exists to police has regressed).
+
+Usage: python benchmarks/bench_wan_sim.py [--n 4] [--eras 3]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the curve's x axis: one-way link latency per point (the observed RTT is
+# measured, not assumed — loopback + flush pacing add real overhead)
+DEFAULT_POINTS = (
+    "",  # unshaped baseline
+    "regions=us,eu;default=20ms/2ms;intra=2ms",
+    "regions=us,eu,ap,sa;default=60ms/5ms;intra=2ms",
+)
+
+
+class Rng:
+    def __init__(self, seed=1):
+        self._r = random.Random(seed)
+
+    def randbelow(self, n):
+        return self._r.randrange(n)
+
+
+async def run_point(args, spec: str) -> dict:
+    from lachain_tpu.core.fleet import TcpFleet
+    from lachain_tpu.core.types import Transaction, sign_transaction
+    from lachain_tpu.crypto import ecdsa
+    from lachain_tpu.network.faults import LinkShaper
+
+    user_priv = ecdsa.generate_private_key(Rng(5))
+    user_addr = ecdsa.address_from_public_key(
+        ecdsa.public_key_bytes(user_priv)
+    )
+    fleet = TcpFleet(
+        n=args.n,
+        f=(args.n - 1) // 3,
+        seed=args.seed,
+        txs_per_block=max(128, args.txs),
+        initial_balances={user_addr: 10**24},
+        shaper=LinkShaper.parse(spec) if spec else None,
+        era_timeout=args.era_timeout,
+    )
+    await fleet.start()
+    times = []
+    try:
+        nonce = 0
+        for era in range(1, args.eras + 1):
+            txs = [
+                sign_transaction(
+                    Transaction(
+                        to=bytes([era % 256]) * 20,
+                        value=1,
+                        nonce=nonce + k,
+                        gas_price=1,
+                        gas_limit=21000,
+                    ),
+                    user_priv,
+                    fleet.chain_id,
+                )
+                for k in range(args.txs)
+            ]
+            nonce += args.txs
+            await fleet.submit_and_settle(txs)
+            t0 = time.perf_counter()
+            await fleet.run_era(era)
+            times.append(time.perf_counter() - t0)
+        rtt_ms = fleet.rtt_ms()
+    finally:
+        await fleet.stop()
+    times.sort()
+    return {
+        "wan": spec,
+        "rtt_ms": rtt_ms,
+        "era_p50_s": round(times[len(times) // 2], 4),
+        "era_p99_s": round(times[-1], 4),
+        "spread_pct": round(
+            100.0 * (times[-1] - times[0]) / max(times[len(times) // 2], 1e-9),
+            1,
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--eras", type=int, default=3)
+    ap.add_argument("--txs", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--era-timeout", type=float, default=120.0)
+    ap.add_argument(
+        "--point",
+        action="append",
+        default=[],
+        metavar="SPEC",
+        help="LinkShaper spec for one curve point ('' = unshaped), "
+        "repeatable; default is a 3-point 0/20/60ms curve",
+    )
+    ap.add_argument(
+        "--max-rtt-slope",
+        type=float,
+        default=40.0,
+        help="sub-linearity gate: max allowed (p99 - baseline p99) per "
+        "second of observed RTT (~sequential protocol rounds)",
+    )
+    args = ap.parse_args()
+    points = args.point if args.point else list(DEFAULT_POINTS)
+    if len(points) < 3:
+        print("need >= 3 curve points", file=sys.stderr)
+        return 2
+
+    curve = []
+    for spec in points:
+        print(f"point: {spec or '(unshaped)'} ...", file=sys.stderr)
+        curve.append(asyncio.run(run_point(args, spec)))
+        print(f"  -> {json.dumps(curve[-1], sort_keys=True)}", file=sys.stderr)
+
+    base = curve[0]
+    steepest = max(curve, key=lambda p: p["rtt_ms"])
+    collapse = []
+    for pt in curve[1:]:
+        rtt_s = max(pt["rtt_ms"] - base["rtt_ms"], 1.0) / 1000.0
+        slope = (pt["era_p99_s"] - base["era_p99_s"]) / rtt_s
+        if slope > args.max_rtt_slope:
+            collapse.append(
+                f"{pt['wan']}: slope {slope:.1f} RTTs/era > "
+                f"{args.max_rtt_slope} (p99 {pt['era_p99_s']}s at "
+                f"rtt {pt['rtt_ms']}ms vs base {base['era_p99_s']}s)"
+            )
+    print(
+        json.dumps(
+            {
+                "metric": "wan_era_latency_s",
+                "value": steepest["era_p99_s"],
+                "unit": (
+                    f"s/era p99 @ N={args.n} TCP fleet, steepest WAN point"
+                ),
+                "n_validators": args.n,
+                "eras_per_point": args.eras,
+                "era_latency_p99_s": steepest["era_p99_s"],
+                "era_latency_p50_s": steepest["era_p50_s"],
+                "rtt_ms": steepest["rtt_ms"],
+                "wan_curve": curve,
+                "max_rtt_slope": args.max_rtt_slope,
+                "sub_linear": not collapse,
+                # loopback TCP timing is noisy; let the gate widen itself
+                # from the observed spread (compare.py threshold_pct)
+                "trial_spread_pct": max(p["spread_pct"] for p in curve),
+            },
+            sort_keys=True,
+        )
+    )
+    if collapse:
+        for msg in collapse:
+            print(f"COLLAPSE: {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

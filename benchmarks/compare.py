@@ -48,6 +48,13 @@ LATENCY_FIELDS = (
     # both runs report them, so pre-15 baselines stay valid.
     "tx_e2e_p50_s",
     "tx_e2e_p99_s",
+    # WAN survival curve (PR 18, bench_wan_sim): era commit p99 under the
+    # steepest shaped RTT point, plus the observed SRTT itself — rtt_ms
+    # rising means the shaper (or the real WAN) got slower, which would
+    # otherwise masquerade as an era-latency regression. Only compared
+    # when both runs report them, so pre-18 baselines stay valid.
+    "era_latency_p99_s",
+    "rtt_ms",
 )
 
 # throughput-shaped side fields compared higher-is-better when both runs

@@ -36,6 +36,13 @@ composition):
       commit workload and is SIGKILLed at the named pipeline point; the
       parent fscks the torn database, repairs, and verifies a resumed run
       completes (DEPLOY.md, Crash recovery).
+  lachain-tpu fleet-upgrade --n 6 --wan 'regions=us,eu;default=40ms/5ms'
+      zero-downtime rolling-upgrade drill: an in-process TCP fleet boots
+      on the legacy (pre-handshake) wire, optionally WAN-shaped into
+      emulated regions, and rolls node-by-node onto the LTRX versioned
+      wire under paced traffic. Gated on /healthz staying ok and zero
+      fleet missed eras; prints a compare.py-readable JSON result
+      (DEPLOY.md, WAN operations & rolling upgrades).
   lachain-tpu fsck --config netdir/config0.json [--deep] [--no-repair]
       storage invariant scan: detects torn states (orphan block, lost
       state roots, stale journal eras), repairs what is safely repairable.
@@ -87,6 +94,11 @@ def cmd_keygen(args) -> int:
     for extra in args.fund or []:
         balances[extra] = str(args.initial_balance)
     consensus_hex = pub.encode().hex()
+    regions = (
+        [r.strip() for r in args.regions.split(",") if r.strip()]
+        if getattr(args, "regions", None)
+        else []
+    )
     for i in range(n):
         wallet_path = os.path.join(args.out, f"wallet{i}.json")
         password = secrets.token_hex(8) if args.encrypt else ""
@@ -139,6 +151,14 @@ def cmd_keygen(args) -> int:
             # get sqlite pinned instead, core/config.py _v6_to_v7)
             "storage": {"engine": "lsm"},
         }
+        # WAN emulation knobs are additive network keys: `region` labels the
+        # node's emulated region (round-robin over --regions, matching
+        # LinkShaper's positional striping), `wanShaper` carries the shared
+        # LinkShaper spec so the emulated matrix is fleet-wide consistent
+        if regions:
+            cfg["network"]["region"] = regions[i % len(regions)]
+        if getattr(args, "wan", None):
+            cfg["network"]["wanShaper"] = args.wan
         path = os.path.join(args.out, f"config{i}.json")
         with open(path, "w") as fh:
             json.dump(cfg, fh, indent=2, sort_keys=True)
@@ -224,6 +244,21 @@ def _build_node(cfg, config_path=None):
         # observability.idleAlertFraction: /healthz reads degraded when
         # the rolling era idle fraction exceeds this
         node.idle_alert_fraction = float(cfg.idle_alert_fraction)
+    if cfg.wan_shaper:
+        # network.wanShaper: emulated WAN matrix on this node's outbound
+        # frames (network/faults.py LinkShaper). Every node in the fleet
+        # carries the same spec, so the pairwise latency/bandwidth matrix
+        # is consistent even though each node only shapes its own sends.
+        # Validator indices are the shaper's node ids — the same striping
+        # keygen --regions writes into network.region.
+        from .network.faults import FaultPlan, LinkShaper
+
+        shaper = LinkShaper.parse(cfg.wan_shaper)
+        node.network.install_faults(
+            FaultPlan(seed=cfg.genesis.chain_id, shaper=shaper), idx
+        )
+        for j, vpub in enumerate(pub.ecdsa_pub_keys):
+            node.network.map_fault_peer(vpub, j)
     peers = []
     for spec in cfg.network.peers:
         host, port, pubhex = spec.rsplit(":", 2)
@@ -550,10 +585,14 @@ def cmd_fleet_trace(args) -> int:
     for n in merged["fleet"]["nodes"]:
         status = n["status"] or "?"
         unc = n["uncertaintyUs"]
+        rtt = n.get("rttMaxMs")
+        wirev = n.get("wireVersion")
         print(
             f"{n['name']}: status={status} "
             f"offset={n['offsetUs'] or 0:.0f}us"
             + (f" (±{unc:.0f}us)" if unc is not None else "")
+            + (f" rtt_max={rtt:.0f}ms" if rtt is not None else "")
+            + (f" wire=v{wirev}" if wirev is not None else "")
         )
     if args.out:
         with open(args.out, "w") as fh:
@@ -598,6 +637,15 @@ def cmd_chaos(args) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    shaper = None
+    if getattr(args, "wan", None):
+        from .network.faults import LinkShaper
+
+        try:
+            shaper = LinkShaper.parse(args.wan)
+        except ValueError as e:
+            print(f"error: bad --wan spec: {e}", file=sys.stderr)
+            return 2
     plan = FaultPlan(
         seed=args.seed,
         drop=args.drop,
@@ -608,6 +656,7 @@ def cmd_chaos(args) -> int:
         partitions=tuple(
             FaultPlan.parse_partition(s) for s in args.partition
         ),
+        shaper=shaper,
     )
     print(
         f"chaos: n={args.n} f={args.f} eras={args.eras} seed={args.seed} "
@@ -617,6 +666,7 @@ def cmd_chaos(args) -> int:
         f"plan: drop={plan.drop} duplicate={plan.duplicate} "
         f"delay={plan.delay} reorder={plan.reorder} "
         f"crashes={len(plan.crashes)} partitions={len(plan.partitions)}"
+        + (f" wan={args.wan}" if shaper is not None else "")
     )
     if adversary is not None:
         print(
@@ -695,6 +745,143 @@ def cmd_chaos(args) -> int:
         return 1
     print(f"ok: {args.eras} eras survived the plan")
     return 0
+
+
+def cmd_fleet_upgrade(args) -> int:
+    """Zero-downtime rolling-upgrade drill: boot an n-node loopback TCP
+    fleet on the legacy (pre-handshake) wire, optionally WAN-shaped into
+    emulated regions, then roll every node one at a time onto the
+    upgraded wire while the survivors keep committing eras under paced
+    open-loop traffic. Gates: /healthz stays `ok` on every live node at
+    every era checkpoint and the FLEET misses zero eras (a rolling node
+    sitting one out is the expected shape). Prints a compare.py-readable
+    JSON result line (era_latency_p99_s + rtt_ms)."""
+    import random
+    import time
+
+    from .core.fleet import TcpFleet
+    from .core.types import Transaction, sign_transaction
+    from .crypto import ecdsa
+    from .network import wire
+    from .network.faults import LinkShaper
+
+    shaper = None
+    if args.wan:
+        try:
+            shaper = LinkShaper.parse(args.wan)
+        except ValueError as e:
+            print(f"error: bad --wan spec: {e}", file=sys.stderr)
+            return 2
+
+    class _Rng:
+        def __init__(self, seed):
+            self._r = random.Random(seed)
+
+        def randbelow(self, k):
+            return self._r.randrange(k)
+
+    async def drill() -> int:
+        user_priv = ecdsa.generate_private_key(_Rng(args.seed + 1))
+        user_addr = ecdsa.address_from_public_key(
+            ecdsa.public_key_bytes(user_priv)
+        )
+        fleet = TcpFleet(
+            n=args.n,
+            f=args.f,
+            seed=args.seed,
+            txs_per_block=max(128, args.txs_per_era),
+            initial_balances={user_addr: 10**24},
+            shaper=shaper,
+            legacy_wire=True,
+            era_timeout=args.era_timeout,
+        )
+        era = 0
+        nonce = 0
+        era_lat: List[float] = []
+        failures: List[str] = []
+
+        async def one_era() -> None:
+            nonlocal era, nonce
+            era += 1
+            txs = [
+                sign_transaction(
+                    Transaction(
+                        to=b"\x0d" * 20,
+                        value=1,
+                        nonce=nonce + j,
+                        gas_price=1,
+                        gas_limit=21000,
+                    ),
+                    user_priv,
+                    fleet.chain_id,
+                )
+                for j in range(args.txs_per_era)
+            ]
+            nonce += args.txs_per_era
+            await fleet.submit_and_settle(txs)
+            t0 = time.perf_counter()
+            h = await fleet.run_era(era)
+            era_lat.append(time.perf_counter() - t0)
+            bad = {
+                i: s for i, s in fleet.health_statuses().items() if s != "ok"
+            }
+            if bad:
+                failures.append(f"era {era}: health left ok: {bad}")
+            print(
+                f"era {era:>3}: {h.hex()[:16]} {era_lat[-1]:.2f}s "
+                f"health={'ok' if not bad else bad} rtt_ms={fleet.rtt_ms()}"
+            )
+
+        await fleet.start()
+        try:
+            for _ in range(args.warmup):
+                await one_era()
+            for i in range(args.n):
+                await fleet.take_down(i)
+                region = fleet.region_of(i) or "-"
+                print(f"roll: node {i} down (region {region})")
+                await one_era()  # survivors commit with node i out
+                await fleet.bring_up(i, next_era=era + 1)
+                print(
+                    f"roll: node {i} back on wire v{fleet.wire_versions()[i]}"
+                )
+            for _ in range(args.cooldown):
+                await one_era()
+            rtt = fleet.rtt_ms()
+            versions = fleet.wire_versions()
+        finally:
+            await fleet.stop()
+        if any(v != wire.WIRE_VERSION for v in versions.values()):
+            failures.append(f"nodes left on the old wire: {versions}")
+        lat = sorted(era_lat)
+        result = {
+            "metric": "fleet_upgrade",
+            "n": args.n,
+            "f": args.f,
+            "eras": era,
+            "rolled": args.n,
+            "era_latency_p50_s": round(lat[len(lat) // 2], 4),
+            "era_latency_p99_s": round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4
+            ),
+            "rtt_ms": rtt,
+            "wire_versions": {str(k): v for k, v in versions.items()},
+            "healthz_ok": not failures,
+            "wan": args.wan or "",
+        }
+        print(json.dumps(result, sort_keys=True))
+        if failures:
+            for msg in failures:
+                print(msg, file=sys.stderr)
+            print("FLEET-UPGRADE DRILL FAILED", file=sys.stderr)
+            return 1
+        print(
+            f"ok: rolled {args.n}/{args.n} nodes, zero fleet missed eras, "
+            f"/healthz ok throughout"
+        )
+        return 0
+
+    return asyncio.run(drill())
 
 
 def cmd_run(args) -> int:
@@ -1017,6 +1204,18 @@ def main(argv=None) -> int:
     kg.add_argument(
         "--encrypt", action="store_true", help="password-protect wallets"
     )
+    kg.add_argument(
+        "--regions",
+        metavar="R1,R2,...",
+        help="stripe nodes round-robin across these emulated regions "
+             "(written as network.region; node i gets region i %% len)",
+    )
+    kg.add_argument(
+        "--wan",
+        metavar="SPEC",
+        help="LinkShaper spec written to every config's network.wanShaper, "
+             "e.g. 'regions=us,eu,ap,sa;default=80ms/8ms@4mbps;intra=2ms'",
+    )
     kg.set_defaults(fn=cmd_keygen)
 
     rn = sub.add_parser("run", help="run a node from a config")
@@ -1212,7 +1411,32 @@ def main(argv=None) -> int:
                     metavar="I,J,...",
                     help="comma-separated traitor ids for --byzantine "
                          "(default: validators 0..f-1)")
+    ch.add_argument("--wan", default=None, metavar="SPEC",
+                    help="LinkShaper WAN matrix on every link (python "
+                         "engine only), e.g. 'regions=us,eu;"
+                         "default=40ms/5ms;intra=2ms;burst=0.01x8'")
     ch.set_defaults(fn=cmd_chaos)
+
+    fu = sub.add_parser(
+        "fleet-upgrade",
+        help="zero-downtime rolling-upgrade drill: legacy-wire TCP fleet "
+             "rolled node-by-node onto the LTRX wire under traffic, gated "
+             "on /healthz + zero fleet missed eras",
+    )
+    fu.add_argument("--n", type=int, default=6)
+    fu.add_argument("--f", type=int, default=1)
+    fu.add_argument("--seed", type=int, default=0)
+    fu.add_argument("--wan", default=None, metavar="SPEC",
+                    help="LinkShaper spec shaping the fleet's loopback "
+                         "links into emulated regions")
+    fu.add_argument("--txs-per-era", type=int, default=8,
+                    help="open-loop transactions paced in before each era")
+    fu.add_argument("--warmup", type=int, default=1,
+                    help="eras committed before the roll starts")
+    fu.add_argument("--cooldown", type=int, default=1,
+                    help="eras committed after every node is upgraded")
+    fu.add_argument("--era-timeout", type=float, default=60.0)
+    fu.set_defaults(fn=cmd_fleet_upgrade)
 
     fs = sub.add_parser(
         "fsck", help="scan storage invariants; repair or refuse"

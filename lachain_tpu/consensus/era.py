@@ -214,12 +214,19 @@ class EraRouter(Broadcaster):
             if target is None or target == requester
         ]
 
-    def replay_outbox(self, era: int, requester: int) -> int:
+    def replay_outbox(
+        self, era: int, requester: int, limit: Optional[int] = None
+    ) -> int:
         """Re-send `era`'s outbox to `requester` (message_request service).
         Goes straight through the transport — NOT via send_to — so replays
         are never re-recorded (a replay of a replay would grow the outbox
-        unboundedly)."""
+        unboundedly). `limit` caps the batch (in send order, so protocol
+        progression replays front-first); the node scales it with observed
+        RTT — a distant requester waits longer between requests, so each
+        round must carry more."""
         payloads = self.outbox_payloads(era, requester)
+        if limit is not None:
+            payloads = payloads[:limit]
         for payload in payloads:
             self._send(requester, payload)
         if payloads:

@@ -582,16 +582,21 @@ class NativeEraRouter(EraRouter):
         else:
             raise TypeError(f"unexpected python-protocol payload {type(payload)}")
 
-    def replay_outbox(self, era: int, requester: int) -> int:
+    def replay_outbox(
+        self, era: int, requester: int, limit: Optional[int] = None
+    ) -> int:
         """Retransmission service over the engine transport. The engine only
         floods (its receive paths are idempotent — repeated shares are
         dropped by the per-sender latches), so a targeted replay request is
         answered with a re-broadcast of the recorded payloads. The engine
         runs the router's current era only; older eras' flood traffic is
-        engine-internal and already superseded by the decided block."""
+        engine-internal and already superseded by the decided block.
+        `limit` caps the batch, same contract as EraRouter.replay_outbox."""
         if not (self.window_floor <= era <= self.era):
             return 0
         payloads = self.outbox_payloads(era, requester)
+        if limit is not None:
+            payloads = payloads[:limit]
         for payload in payloads:
             self._engine_transport(payload)
         if payloads:
@@ -816,6 +821,8 @@ class NativeSimulatedNetwork:
                 unsupported.append("partitions")
             if any(c.restart is not None for c in fault_plan.crashes):
                 unsupported.append("crash restart")
+            if getattr(fault_plan, "shaper", None) is not None:
+                unsupported.append("link shaper")
             if unsupported:
                 raise ValueError(
                     "native engine cannot express FaultPlan feature(s): "

@@ -24,6 +24,7 @@ message_request wire exchange).
 from __future__ import annotations
 
 import enum
+import heapq
 import random
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -81,6 +82,12 @@ class SimulatedNetwork:
         self._queue = (
             [] if mode is DeliveryMode.TAKE_RANDOM else deque()
         )
+        # time-armed copies (fault delays + LinkShaper latency): a heap of
+        # (ready_at, seq, sender, target, payload) surfaced once the
+        # virtual clock reaches ready_at. The seq tiebreak keeps pops
+        # deterministic and keeps payloads out of heap comparisons.
+        self._delayed: List[Tuple[float, int, int, int, Any]] = []
+        self._delay_seq = 0
         self.routers: List[EraRouter] = []
         for i in range(self.n):
             self.routers.append(
@@ -179,12 +186,36 @@ class SimulatedNetwork:
         max_messages: int = 1_000_000,
     ) -> bool:
         """Deliver until `done()` or quiescence/cap. True iff done() held."""
-        batcher = self.crypto_batcher
         while not done():
+            if self._delayed and self._delayed[0][0] <= self._vtime:
+                # a time-armed copy's moment has come: deliver it directly —
+                # its link decision was already made when it was armed, so
+                # WAN latency defers a message without re-rolling its fate
+                if self.delivered_count >= max_messages:
+                    raise RuntimeError(
+                        f"message cap {max_messages} exceeded — livelock?"
+                    )
+                _, _, sender, target, payload = heapq.heappop(self._delayed)
+                self.delivered_count += 1
+                self._vtime += 1.0
+                if type(payload) is M.DecryptedMessage:
+                    self._decrypted_in_queue -= 1
+                if target not in self.muted and not (
+                    self.faults is not None and self.faults.crashed(target)
+                ):
+                    self.routers[target].dispatch_external(sender, payload)
+                self._maybe_flush()
+                continue
             if not self._queue:
+                if self._delayed:
+                    # every undelivered message is still in flight on a
+                    # shaped/delayed link: advance the virtual clock to the
+                    # earliest arrival (latency passing, not quiescence)
+                    self._vtime = max(self._vtime, self._delayed[0][0])
+                    continue
                 metrics.set_gauge("consensus_dispatch_queue_depth", 0)
-                if batcher is not None and batcher.pending:
-                    batcher.flush()
+                if self.crypto_batcher is not None and self.crypto_batcher.pending:
+                    self.crypto_batcher.flush()
                     continue
                 if self.faults is not None:
                     # outbox replay is the in-process stand-in for the
@@ -207,33 +238,45 @@ class SimulatedNetwork:
             deliver = True
             if self.faults is not None and sender != target:
                 # self-delivery never traverses the network: only link
-                # traffic is subject to loss/dup/delay/partition
+                # traffic is subject to loss/dup/delay/partition/shaping
                 delays = self.faults.decide(sender, target)
                 deliver = bool(delays) and delays[0] <= 0
-                requeues = (len(delays) - 1) + (
-                    1 if delays and delays[0] > 0 else 0
-                )
-                for _ in range(requeues):
-                    # a delayed copy re-enters the queue and surfaces later;
-                    # a duplicate is a second full delivery
+                for d in delays[1:] if deliver else delays:
                     if type(payload) is M.DecryptedMessage:
                         self._decrypted_in_queue += 1
-                    self._queue.append((sender, target, payload))
+                    if d <= 0:
+                        # duplicate: a second full traversal of the link,
+                        # re-rolling the dice like any fresh send
+                        self._queue.append((sender, target, payload))
+                    else:
+                        # delayed/shaped copy: armed to surface once the
+                        # clock reaches its delivery time
+                        self._delay_seq += 1
+                        heapq.heappush(
+                            self._delayed,
+                            (
+                                self._vtime + d,
+                                self._delay_seq,
+                                sender,
+                                target,
+                                payload,
+                            ),
+                        )
             elif self.faults is not None and self.faults.crashed(target):
                 deliver = False  # crashed: not even self-delivery
             if deliver and target not in self.muted:
                 # crashed player: no inbound processing either
                 self.routers[target].dispatch_external(sender, payload)
-            if (
-                batcher is not None
-                and batcher.pending
-                and self._decrypted_in_queue == 0
-            ):
-                # every broadcast decryption share has been delivered: the
-                # cross-validator batch is at its largest — flush NOW, before
-                # BinaryAgreement lag rounds spawn fresh coin work
-                batcher.flush()
+            self._maybe_flush()
         return True
+
+    def _maybe_flush(self) -> None:
+        """Flush the TPKE batcher once every queued DecryptedMessage has
+        been delivered: the cross-validator batch is at its largest — flush
+        NOW, before BinaryAgreement lag rounds spawn fresh coin work."""
+        b = self.crypto_batcher
+        if b is not None and b.pending and self._decrypted_in_queue == 0:
+            b.flush()
 
     def _recover(self) -> bool:
         """Quiescent but not done under a fault plan: the wedged-era state

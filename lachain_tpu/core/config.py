@@ -288,6 +288,27 @@ class NodeConfig:
         return None if shift is None else int(shift)
 
     @property
+    def network_region(self) -> Optional[str]:
+        """This node's emulated/labelled WAN region (network.region).
+        Optional and additive (no config version bump): used by the
+        LinkShaper's region matrix and surfaced in fleet views; absent
+        means unlabelled (treated as the shaper's first region when a
+        shaper is installed by position)."""
+        region = self.raw.get("network", {}).get("region")
+        return None if region is None else str(region)
+
+    @property
+    def wan_shaper(self) -> Optional[str]:
+        """WAN link-shaping spec (network.wanShaper), a LinkShaper.parse
+        string like "regions=us,eu;default=40ms/5ms@4mbps;intra=1ms".
+        Optional and additive (no config version bump): absent disables
+        shaping. The SAME spec (and fault seed) must be installed
+        fleet-wide for two-run determinism to hold (DEPLOY.md "WAN
+        operations & rolling upgrades")."""
+        spec = self.raw.get("network", {}).get("wanShaper")
+        return None if spec is None else str(spec)
+
+    @property
     def idle_alert_fraction(self) -> Optional[float]:
         """Idle-anatomy health alert (observability.idleAlertFraction):
         when the rolling era idle fraction from the flight recorder

@@ -70,7 +70,21 @@ class Devnet:
         exec_lanes: int = 1,
         merkle_workers: int = 1,
         adversary=None,
+        link_shaper=None,
     ):
+        # link_shaper (network/faults.py LinkShaper): WAN emulation on the
+        # simulated delivery layer — per-region-pair latency/jitter/
+        # bandwidth in virtual ticks. A convenience over threading a full
+        # FaultPlan: wraps into one (or onto the given plan) here.
+        if link_shaper is not None:
+            import dataclasses as _dc
+
+            from ..network.faults import FaultPlan
+
+            if fault_plan is None:
+                fault_plan = FaultPlan(seed=seed, shaper=link_shaper)
+            else:
+                fault_plan = _dc.replace(fault_plan, shaper=link_shaper)
         self.n, self.f = n, f
         self.chain_id = chain_id
         # pipeline_window > 0 turns run_eras into a windowed scheduler that

@@ -155,10 +155,19 @@ class Node:
         # escalates stall report -> outbox re-request -> forced reconnect
         self.watchdog_interval = 10.0
         self.stall_timeout = 60.0
+        # WAN degradation: the ladder above is tuned for loopback; the
+        # EFFECTIVE stall timeout stretches with observed fleet RTT
+        # (network/rtt.py scale(): never below stall_timeout, capped at
+        # 4x) so a 200 ms-RTT fleet degrades gracefully instead of
+        # escalating to reconnect thrash on a loopback schedule
         # serving side: one outbox replay per (peer, era) per window, so a
         # hammering (or byzantine) requester cannot turn recovery into an
         # amplification attack
         self.replay_min_interval = 2.0
+        # outbox replay batch cap, RTT-scaled upward on slow fleets (a
+        # distant requester waits longer between requests, so each round
+        # must carry more)
+        self.replay_batch_limit = 512
         self._replay_served_at: Dict[tuple, float] = {}
         # native-engine stall detector state: (last_state_string, since, strikes)
         self._native_watch: tuple = ("", 0.0, 0)
@@ -328,6 +337,14 @@ class Node:
             logger.exception("kernel warmup failed to start")
             self._warmup_thread = None
 
+    @property
+    def effective_stall_timeout(self) -> float:
+        """The watchdog's stall threshold, stretched with observed fleet
+        RTT: base stall_timeout on fast links, up to 4x on slow ones
+        (RttTracker.scale). Adaptivity widens patience; it never disables
+        the ladder."""
+        return self.network.rtt.scale(self.stall_timeout)
+
     async def _protocol_watchdog(self) -> None:
         """Protocol stall watchdog with last-message breadcrumb (reference
         AbstractProtocol 'taking too long' warnings, AbstractProtocol.cs:
@@ -357,12 +374,13 @@ class Node:
                     native_state = "<unavailable>"
             # aggregate the ladder per era: one sweep re-requests/reconnects
             # once, however many of the era's protocols are stalled
+            stall_after = self.effective_stall_timeout
             era_stage: Dict[int, int] = {}
             for pid, proto in list(router._protocols.items()):
                 if proto.terminated or proto.result is not None:
                     continue
                 stalled = now - proto.last_activity
-                if stalled > self.stall_timeout:
+                if stalled > stall_after:
                     from ..utils import tracing
 
                     stage = proto.record_stall()
@@ -412,7 +430,7 @@ class Node:
         if native_state != prev_state or not native_state:
             self._native_watch = (native_state, now, 0)
             return 0
-        if now - mark <= self.stall_timeout:
+        if now - mark <= self.effective_stall_timeout:
             return 0
         # with pipelining the router spans a window of in-flight eras;
         # commits are strictly sequential, so the stuck era is the OLDEST
@@ -488,11 +506,12 @@ class Node:
 
         ok       — committing, peered, no watchdog strikes
         degraded — behind the fleet's median height, peerless, tip older
-                   than stall_timeout, one stall strike, or (when
-                   idle_alert_fraction is configured) the rolling era
-                   idle fraction from the flight recorder above it
+                   than the (RTT-stretched) effective stall timeout, one
+                   stall strike, or (when idle_alert_fraction is
+                   configured) the rolling era idle fraction from the
+                   flight recorder above it
         stalled  — watchdog escalated (strike >= 2, python or native) or
-                   no commit for 2x stall_timeout
+                   no commit for 2x the effective stall timeout
         """
         now = time.monotonic()
         tip_age = now - self._last_commit_mono
@@ -524,16 +543,17 @@ class Node:
                     idle_alerting = idle_fraction > self.idle_alert_fraction
             except Exception:
                 pass  # a recorder hiccup must never break the probe
+        stall_after = self.effective_stall_timeout
         verdict = "ok"
         if (
             lag > 5
-            or tip_age > self.stall_timeout
+            or tip_age > stall_after
             or (expected_peers > 0 and not self.network.peers)
             or strikes == 1
             or idle_alerting
         ):
             verdict = "degraded"
-        if strikes >= 2 or tip_age > 2 * self.stall_timeout:
+        if strikes >= 2 or tip_age > 2 * stall_after:
             verdict = "stalled"
         return {
             "status": verdict,
@@ -547,6 +567,12 @@ class Node:
             "commitLagVsPeers": lag,
             "stallStrikes": strikes,
             "idleFraction": idle_fraction,
+            # WAN surface: slowest-peer RTT estimate, the RTT-stretched
+            # stall threshold in force, and our advertised wire version
+            # (fleet dashboards watch the version column during a roll)
+            "rttMaxMs": round(self.network.rtt.max_srtt() * 1000.0, 1),
+            "stallTimeoutEffective": round(stall_after, 1),
+            "wireVersion": self.network.factory.wire_version,
         }
 
     async def start_rpc(
@@ -672,7 +698,12 @@ class Node:
                 for k, v in self._replay_served_at.items()
                 if now - v < self.replay_min_interval
             }
-        payloads = self.router.outbox_payloads(era, sender)
+        # batch cap scales with fleet RTT: a distant requester's next
+        # re-request is an RTT away, so each replay round carries more
+        # (scale(1.0) is the dimensionless stretch factor: 1x on fast
+        # links, up to 4x on slow ones)
+        limit = int(self.replay_batch_limit * self.network.rtt.scale(1.0))
+        payloads = self.router.outbox_payloads(era, sender)[:limit]
         for payload in payloads:
             self.network.send_to(sender_pub, wire.consensus_msg(era, payload))
         if payloads:
@@ -785,7 +816,21 @@ class Node:
 
     # -- era loop (ConsensusManager.Run) ------------------------------------
 
+    def _effective_pipeline_window(self) -> int:
+        """The router's acceptance/retention window, widened by one era
+        once the slowest peer's RTT crosses 150 ms: on a WAN fleet a fast
+        region legitimately runs an era ahead while its traffic is still
+        in flight toward us, and a loopback-sized window would drop (or
+        stall on) that lead. Widening acceptance is safe — commits stay
+        strictly sequential — it only stops distance being mistaken for
+        misbehavior."""
+        window = self.pipeline_window
+        if self.network.rtt.max_srtt() > 0.15:
+            window = max(window, 1)
+        return window
+
     def _ensure_router(self, era: int) -> EraRouter:
+        window = self._effective_pipeline_window()
         if self.router is None:
             self.router = EraRouter(
                 era,
@@ -797,9 +842,9 @@ class Node:
                 journal=self.journal,
                 evidence=self.evidence,
             )
-            self.router.pipeline_window = self.pipeline_window
+            self.router.pipeline_window = window
         else:
-            self.router.pipeline_window = self.pipeline_window
+            self.router.pipeline_window = window
             self.router.advance_era(era)
         self._replay_future()
         return self.router
@@ -873,6 +918,9 @@ class Node:
                 outcome=outcome,
                 trace=wire.era_trace_id(self.network.public_key, era).hex(),
                 peer_traces=",".join(self.network.trace_ids_for(era)),
+                # WAN context on the era span: the fleet merger's
+                # era-latency-vs-RTT curve reads these two together
+                rtt_max_ms=round(self.network.rtt.max_srtt() * 1000.0, 1),
             )
 
     async def run_eras(self, first: int, count: int) -> List[Block]:

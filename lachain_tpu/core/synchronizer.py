@@ -152,10 +152,23 @@ class BlockSynchronizer:
         live.sort(key=lambda hv: (-hv[0], hv[1]))
         return [pub for _, pub in live[:k]]
 
+    def _request_timeout_for(self, pub: Optional[bytes]) -> float:
+        """Per-request abandon threshold: the fixed request_timeout floor,
+        widened to 8x the serving peer's RTO when it measures slower —
+        benching a healthy-but-distant peer for serving at the speed of
+        light would thrash the peer rotation on every WAN batch."""
+        if pub is None:
+            return self.request_timeout
+        rtt = getattr(self.network, "rtt", None)
+        if rtt is None:
+            return self.request_timeout
+        return max(self.request_timeout, 8.0 * rtt.rto(pub))
+
     def _maybe_request(self) -> None:
         if self._request_inflight:
             now = asyncio.get_event_loop().time()
-            if now - self._request_time < self.request_timeout:
+            timeout = self._request_timeout_for(self._request_peer)
+            if now - self._request_time < timeout:
                 return
             # request timed out: bench the unresponsive peer and rotate
             if self._request_peer is not None:

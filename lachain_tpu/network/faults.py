@@ -25,12 +25,20 @@ Time units are layer-relative: the simulator clocks in delivered messages,
 the TCP filter in seconds since installation. A plan authored for one layer
 therefore needs its schedule rescaled for the other; probabilities carry
 over unchanged.
+
+WAN emulation rides on the same contract: a :class:`LinkShaper` attached to
+the plan gives every (region, region) link a base latency, jitter (with
+seeded burst windows), and a bandwidth cap enforced by a per-link pacer.
+Shaped latency is expressed through the existing `decide()` delay-list
+interface, so the simulator, the TCP frame filter, and the hub's delay
+timers all carry it with no extra plumbing — and the decisions draw from
+the same seeded rng, so two same-seed runs shape bit-identically.
 """
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Mapping, Optional, Tuple
 
 from ..utils import metrics
 
@@ -61,6 +69,150 @@ class Partition:
 
 
 @dataclass(frozen=True)
+class LinkShape:
+    """One directed region->region link's shape, in the layer's clock/size
+    units (seconds + bytes on TCP, virtual ticks + nominal frame units in
+    the simulator)."""
+
+    latency: float = 0.0    # one-way base latency
+    jitter: float = 0.0     # uniform extra delay in [0, jitter]
+    bandwidth: float = 0.0  # link capacity, size units per clock unit; 0 = uncapped
+
+
+@dataclass(frozen=True)
+class LinkShaper:
+    """Seeded WAN link shaping: a per-region-pair latency/jitter/bandwidth
+    matrix applied to every frame a FaultSession decides on.
+
+    Node -> region assignment is positional (`regions[node % len]`), so a
+    16-node fleet over `("us", "eu", "ap", "sa")` stripes four emulated
+    regions. Links are DIRECTED: `links[("us", "eu")]` may differ from
+    `links[("eu", "ap")]` (asymmetric paths); a missing ordered pair falls
+    back to the reversed pair, then to `default` for cross-region links.
+    Intra-region links are unshaped unless an explicit ("r", "r") entry or
+    `intra` exists. Jitter draws come from the session's seeded rng and
+    occasionally land in burst windows (`jitter_burst` probability) where
+    the draw is amplified `burst_multiplier`x — the WAN microburst model.
+    The bandwidth cap is a per-link serialization pacer: frame `k` cannot
+    start before frame `k-1` finished transmitting at `bandwidth`
+    units/clock-unit, so a flood on a thin link accumulates queueing delay
+    exactly like a real egress buffer."""
+
+    regions: Tuple[str, ...] = ()
+    links: Mapping[Tuple[str, str], LinkShape] = field(default_factory=dict)
+    default: LinkShape = field(default_factory=LinkShape)
+    intra: Optional[LinkShape] = None
+    jitter_burst: float = 0.0
+    burst_multiplier: float = 4.0
+
+    def region_of(self, node: int) -> str:
+        if not self.regions:
+            return ""
+        return self.regions[node % len(self.regions)]
+
+    def link(self, src: int, dst: int) -> Optional[LinkShape]:
+        """The shape governing src->dst traffic, None = unshaped."""
+        rs, rd = self.region_of(src), self.region_of(dst)
+        shape = self.links.get((rs, rd))
+        if shape is None:
+            shape = self.links.get((rd, rs))
+        if shape is None:
+            if rs == rd:
+                shape = self.intra
+            else:
+                shape = self.default
+        return shape
+
+    # -- spec parsing (CLI flags / config strings / compose env) ------------
+
+    @staticmethod
+    def _dur(s: str) -> float:
+        """"40ms" / "1.5s" -> seconds; a bare float passes through (clock
+        units of whatever layer runs the plan)."""
+        s = s.strip()
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        return float(s)
+
+    @staticmethod
+    def _rate(s: str) -> float:
+        """"4mbps" / "512kbps" -> bytes/second; a bare float passes
+        through (size units per clock unit)."""
+        s = s.strip().lower()
+        if s.endswith("mbps"):
+            return float(s[:-4]) * 125_000.0
+        if s.endswith("kbps"):
+            return float(s[:-4]) * 125.0
+        if s.endswith("bps"):
+            return float(s[:-3]) / 8.0
+        return float(s)
+
+    @classmethod
+    def _shape_of(cls, spec: str) -> LinkShape:
+        """"LAT[/JITTER][@BW]" — e.g. "80ms/8ms@4mbps", "35ms", "3@2"."""
+        bw = 0.0
+        if "@" in spec:
+            spec, _, bw_s = spec.partition("@")
+            bw = cls._rate(bw_s)
+        lat_s, _, jit_s = spec.partition("/")
+        return LinkShape(
+            latency=cls._dur(lat_s),
+            jitter=cls._dur(jit_s) if jit_s else 0.0,
+            bandwidth=bw,
+        )
+
+    @classmethod
+    def parse(cls, spec: str) -> "LinkShaper":
+        """Parse a compact shaper spec, e.g.::
+
+            regions=us,eu,ap,sa;default=80ms/8ms@4mbps;us-eu=35ms;\
+intra=2ms;burst=0.01x8
+
+        Items are ';'-separated `key=value` pairs: `regions` (positional
+        node->region stripes), `default` (cross-region fallback shape),
+        `intra` (same-region shape), `burst=PxM` (jitter burst probability
+        P, multiplier M), and `A-B=SHAPE` directed region-pair entries."""
+        regions: Tuple[str, ...] = ()
+        links: Dict[Tuple[str, str], LinkShape] = {}
+        default = LinkShape()
+        intra: Optional[LinkShape] = None
+        burst_p, burst_m = 0.0, 4.0
+        for item in spec.split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            key, _, val = item.partition("=")
+            if not val:
+                raise ValueError(f"shaper spec item {item!r}: expected key=value")
+            key = key.strip()
+            if key == "regions":
+                regions = tuple(r.strip() for r in val.split(",") if r.strip())
+            elif key == "default":
+                default = cls._shape_of(val)
+            elif key == "intra":
+                intra = cls._shape_of(val)
+            elif key == "burst":
+                p_s, _, m_s = val.partition("x")
+                burst_p = float(p_s)
+                burst_m = float(m_s) if m_s else 4.0
+            elif "-" in key:
+                a, _, b = key.partition("-")
+                links[(a.strip(), b.strip())] = cls._shape_of(val)
+            else:
+                raise ValueError(f"shaper spec item {item!r}: unknown key")
+        return cls(
+            regions=regions,
+            links=links,
+            default=default,
+            intra=intra,
+            jitter_burst=burst_p,
+            burst_multiplier=burst_m,
+        )
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Seeded adversarial schedule. All probabilities are per-message."""
 
@@ -72,6 +224,9 @@ class FaultPlan:
     delay_span: Tuple[float, float] = (1.0, 16.0)  # sampled delay bounds
     partitions: Tuple[Partition, ...] = ()
     crashes: Tuple[Crash, ...] = ()
+    # WAN link shaping (latency matrix / jitter bursts / bandwidth pacing);
+    # None = loopback-flat links, the pre-WAN behavior
+    shaper: Optional[LinkShaper] = None
 
     def session(
         self, clock: Optional[Callable[[], float]] = None, salt: int = 0
@@ -176,7 +331,12 @@ class FaultSession:
             "reordered": 0,
             "blocked": 0,   # partition / crash suppression
             "delivered": 0,
+            "shaped": 0,    # frames that picked up LinkShaper latency
+            "bursts": 0,    # jitter draws that landed in a burst window
         }
+        # LinkShaper bandwidth pacer: per directed link, the clock time the
+        # link's serializer frees up (frame k queues behind frame k-1)
+        self._link_free: Dict[Tuple[int, int], float] = {}
 
     @property
     def now(self) -> float:
@@ -204,12 +364,15 @@ class FaultSession:
 
     # -- per-message decisions ----------------------------------------------
 
-    def decide(self, src: Optional[int], dst: Optional[int]) -> List[float]:
+    def decide(
+        self, src: Optional[int], dst: Optional[int], size: int = 1
+    ) -> List[float]:
         """The fate of one message on the src->dst link: a list of delivery
         delays, one per copy. `[]` = dropped, `[0.0]` = delivered now,
         `[0.0, 0.0]` = duplicated, `[d]` = delivered after `d` time units.
         Unknown endpoints (None) skip link-state checks but still roll the
-        probabilistic faults."""
+        probabilistic faults. `size` feeds the LinkShaper bandwidth pacer
+        (frame bytes on TCP, a nominal 1 unit in the simulator)."""
         p = self.plan
         if self.link_blocked(src, dst):
             self.stats["blocked"] += 1
@@ -229,8 +392,47 @@ class FaultSession:
             delays.append(0.0)
             self.stats["duplicated"] += 1
             metrics.inc("fault_injected_total", labels={"action": "dup"})
+        shaped = self._shape(src, dst, size)
+        if shaped > 0:
+            # every copy of the frame crosses the same WAN link; shifting
+            # them all keeps duplicate spacing intact
+            delays = [d + shaped for d in delays]
+            self.stats["shaped"] += 1
+            metrics.inc("fault_injected_total", labels={"action": "shape"})
         self.stats["delivered"] += 1
         return delays
+
+    def _shape(
+        self, src: Optional[int], dst: Optional[int], size: int
+    ) -> float:
+        """LinkShaper latency for one frame: base + (burst-amplified)
+        jitter + bandwidth serialization/queueing delay. 0.0 = unshaped
+        link. Jitter draws come from the session rng; pacer state advances
+        per call — both deterministic given the call sequence, which is the
+        same bit-identity contract the rest of the plan honors."""
+        shaper = self.plan.shaper
+        if shaper is None or src is None or dst is None or src == dst:
+            return 0.0
+        link = shaper.link(src, dst)
+        if link is None:
+            return 0.0
+        lat = link.latency
+        if link.jitter > 0:
+            j = self.rng.random() * link.jitter
+            if (
+                shaper.jitter_burst > 0
+                and self.rng.random() < shaper.jitter_burst
+            ):
+                j *= shaper.burst_multiplier
+                self.stats["bursts"] += 1
+            lat += j
+        if link.bandwidth > 0 and size > 0:
+            now = self.now
+            start = max(now, self._link_free.get((src, dst), 0.0))
+            done = start + size / link.bandwidth
+            self._link_free[(src, dst)] = done
+            lat += done - now
+        return lat
 
     def reorder_hit(self) -> bool:
         """One roll of the reorder die (the queue owner does the swap)."""
@@ -266,7 +468,7 @@ class TcpFrameFilter:
 
     def outbound(self, peer, data: bytes) -> List[float]:
         dst = self._peer_index(peer) if peer is not None else None
-        return self.session.decide(self.my_id, dst)
+        return self.session.decide(self.my_id, dst, size=len(data))
 
     def inbound(self, data: bytes) -> List[float]:
         if self.session.crashed(self.my_id):

@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 from ..utils import metrics
 from . import wire
 from .hub import Hub, PeerAddress
+from .rtt import RttTracker
 from .wire import MessageBatch, MessageFactory, NetworkMessage
 from .worker import ClientWorker
 
@@ -95,6 +96,22 @@ class NetworkManager:
         self._reregister_task = None
         # as a SENDER: peers reachable only through a relay
         self._relay_route: Dict[bytes, bytes] = {}    # peer pub -> relay pub
+        # --- WAN adaptivity ---
+        # per-peer RTT EWMAs off the ping exchange; timeout scaling for the
+        # watchdog / synchronizer / reconnect rationing reads these
+        self.rtt = RttTracker()
+        # wire/engine versions peers have advertised via the LTRX batch
+        # tail. Absent entry = legacy peer (assumed wire v1); gating only
+        # ever applies to EXPLICITLY-advertised-older peers, so a fleet of
+        # pre-handshake builds behaves exactly as before
+        self.peer_versions: Dict[bytes, wire.WireHandshake] = {}
+        # strike-3 forced-reconnect rationing: a per-peer token bucket so
+        # sustained high RTT cannot reconnect-thrash a slow-but-alive peer
+        # every escalation cycle. Refill interval stretches with observed
+        # fleet RTT (slower fleet -> scarcer reconnects).
+        self.reconnect_bucket_capacity = 2.0
+        self.reconnect_min_interval = 30.0
+        self._reconnect_buckets: Dict[bytes, List[float]] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -337,7 +354,38 @@ class NetworkManager:
 
     # -- sending -----------------------------------------------------------
 
+    def wire_version_of(self, public_key: bytes) -> Optional[int]:
+        """The wire version `public_key` has advertised, None when it never
+        has (legacy peer or no traffic yet)."""
+        hs = self.peer_versions.get(public_key)
+        return hs.wire_version if hs is not None else None
+
+    def _version_gated(self, public_key: bytes, msg: NetworkMessage) -> bool:
+        """True when `msg` must NOT be sent to `public_key`: the peer has
+        EXPLICITLY advertised a wire version too old to decode the kind
+        (its decoder would reject the whole batch, dropping innocent
+        messages sharing the flush). Unknown peers are never gated —
+        pre-handshake fleets keep the status quo."""
+        advertised = self.wire_version_of(public_key)
+        if advertised is None:
+            return False
+        if advertised >= wire.KIND_MIN_WIRE.get(msg.kind, 1):
+            return False
+        metrics.inc(
+            "network_msgs_version_gated_total",
+            labels={"kind": str(msg.kind)},
+        )
+        logger.debug(
+            "kind=%d gated toward peer %s (advertised wire v%d)",
+            msg.kind, public_key.hex()[:16], advertised,
+        )
+        return True
+
     def send_to(self, public_key: bytes, msg: NetworkMessage) -> None:
+        if self._version_gated(public_key, msg):
+            return
+        if msg.kind == wire.KIND_PING_REQUEST:
+            self.rtt.note_sent(public_key)
         worker = self._workers.get(public_key)
         if worker is None:
             self._prune_relay_clients()
@@ -414,7 +462,11 @@ class NetworkManager:
             self._last_conn.pop(p, None)
 
     def broadcast(self, msg: NetworkMessage) -> None:
-        for worker in self._workers.values():
+        for pub, worker in self._workers.items():
+            if self._version_gated(pub, msg):
+                continue
+            if msg.kind == wire.KIND_PING_REQUEST:
+                self.rtt.note_sent(pub)
             worker.enqueue(msg)
 
     # -- failure handling ----------------------------------------------------
@@ -443,20 +495,61 @@ class NetworkManager:
         identity is (link-level partitions/crashes need the mapping)."""
         getattr(self, "_fault_peer_ids", {})[public_key] = node_id
 
-    def reconnect_peers(self) -> None:
-        """Stall-escalation last resort: drop every cached outbound socket
-        and reset worker backoff, so the next flush re-dials immediately
-        instead of waiting out an exponential-backoff window against a
-        peer that already recovered."""
-        metrics.inc("network_forced_reconnect_total")
-        logger.warning(
-            "forcing reconnect of %d peer connections", len(self.hub._conns)
+    def _reconnect_allowed(self, public_key: bytes, now: float) -> bool:
+        """Spend one token from `public_key`'s reconnect bucket. Refill is
+        one token per reconnect_min_interval, with the interval stretched
+        by the fleet RTT estimate: on a 200 ms-RTT fleet a strike-3 cycle
+        fires on a loopback-tuned schedule, and uncapped it would tear
+        down and re-dial a slow-but-alive peer's connection faster than
+        the handshake + zlib warmup it just threw away."""
+        interval = self.rtt.scale(self.reconnect_min_interval)
+        bucket = self._reconnect_buckets.get(public_key)
+        if bucket is None:
+            bucket = self._reconnect_buckets[public_key] = [
+                self.reconnect_bucket_capacity, now
+            ]
+        tokens, last = bucket
+        tokens = min(
+            self.reconnect_bucket_capacity,
+            tokens + (now - last) / interval,
         )
-        for w in list(self.hub._conns.values()):
-            w.close()
-        self.hub._conns.clear()
-        for worker in self._workers.values():
+        if tokens < 1.0:
+            bucket[0], bucket[1] = tokens, now
+            return False
+        bucket[0], bucket[1] = tokens - 1.0, now
+        return True
+
+    def reconnect_peers(self, *, force: bool = False) -> int:
+        """Stall-escalation last resort: drop cached outbound sockets and
+        reset worker backoff, so the next flush re-dials immediately
+        instead of waiting out an exponential-backoff window against a
+        peer that already recovered. Rationed per peer through an
+        RTT-scaled token bucket (`force=True` bypasses — operator CLI);
+        returns the number of peers actually reconnected."""
+        import time
+
+        now = time.monotonic()
+        reconnected = 0
+        for pub, worker in self._workers.items():
+            if not force and not self._reconnect_allowed(pub, now):
+                metrics.inc("watchdog_reconnects_suppressed_total")
+                logger.info(
+                    "reconnect of peer %s suppressed (token bucket)",
+                    pub.hex()[:16],
+                )
+                continue
+            key = (worker.peer.host, worker.peer.port)
+            conn = self.hub._conns.pop(key, None)
+            if conn is not None:
+                conn.close()
             worker.reset_backoff()
+            reconnected += 1
+        if reconnected:
+            metrics.inc("network_forced_reconnect_total")
+            logger.warning(
+                "forcing reconnect of %d peer connections", reconnected
+            )
+        return reconnected
 
     # -- receiving ---------------------------------------------------------
 
@@ -475,6 +568,7 @@ class NetworkManager:
             logger.warning("corrupt batch content dropped")
             return
         self._note_trace_ctx(batch)
+        self._note_handshake(batch)
         if conn_id is not None:
             # remember the latest live inbound connection per verified
             # sender: the reverse-delivery path to NAT'd relay clients.
@@ -519,6 +613,39 @@ class NetworkManager:
                 sender=batch.sender.hex()[:16],
             )
 
+    def _note_handshake(self, batch: MessageBatch) -> None:
+        """Record the sender's advertised versions from a VERIFIED batch.
+        Logged on first sighting and on change (a mid-roll restart flips a
+        peer's version); incompatible peers are surfaced loudly but NOT
+        disconnected — the adjacency contract makes |Δ|<=1 interoperable,
+        and anything wider is an operator error the metric should page on,
+        not a reason to shrink quorum further."""
+        hs = batch.handshake()
+        if hs is None:
+            return
+        prev = self.peer_versions.get(batch.sender)
+        if prev == hs:
+            return
+        self.peer_versions[batch.sender] = hs
+        metrics.set_gauge(
+            "network_peer_wire_version",
+            hs.wire_version,
+            labels={"peer": batch.sender[:4].hex()},
+        )
+        logger.info(
+            "peer %s advertises wire v%d engine v%d features=0x%x",
+            batch.sender.hex()[:16],
+            hs.wire_version, hs.engine_version, hs.features,
+        )
+        if not wire.compatible(hs.wire_version, self.factory.wire_version):
+            metrics.inc("network_peer_version_incompatible_total")
+            logger.error(
+                "peer %s wire v%d is OUTSIDE the v%d±1 compatibility "
+                "window — upgrade lag exceeds one version",
+                batch.sender.hex()[:16],
+                hs.wire_version, self.factory.wire_version,
+            )
+
     def trace_ids_for(self, era: int) -> List[str]:
         """Trace ids seen on inbound consensus traffic for `era` (sorted
         for deterministic span annotations)."""
@@ -531,8 +658,17 @@ class NetworkManager:
             self.on_consensus(sender, era, payload)
         elif k == wire.KIND_PING_REQUEST and self.on_ping_request:
             self.on_ping_request(sender, wire.parse_height(msg))
-        elif k == wire.KIND_PING_REPLY and self.on_ping_reply:
-            self.on_ping_reply(sender, wire.parse_height(msg))
+        elif k == wire.KIND_PING_REPLY:
+            # RTT sample first: the ping exchange doubles as the WAN
+            # latency instrument (network/rtt.py)
+            self.rtt.note_reply(sender)
+            w = self._workers.get(sender)
+            if w is not None:
+                # redial pacing floor: retrying faster than the link's
+                # RTT burns dials that cannot have completed yet
+                w.backoff_floor = self.rtt.srtt(sender) or 0.0
+            if self.on_ping_reply:
+                self.on_ping_reply(sender, wire.parse_height(msg))
         elif k == wire.KIND_SYNC_BLOCKS_REQUEST and self.on_sync_blocks_request:
             start, count = wire.parse_sync_blocks_request(msg)
             self.on_sync_blocks_request(sender, start, count)

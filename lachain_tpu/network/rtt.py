@@ -1,0 +1,164 @@
+"""Per-peer RTT estimation: the clock source for WAN-adaptive recovery.
+
+The block synchronizer already floods `ping_request`/`ping_reply` once a
+second to track peer heights (core/synchronizer.py); this module turns that
+existing exchange into an RTT instrument. `NetworkManager` stamps the send
+time of each ping and feeds the reply latency into an RFC 6298-style
+smoothed estimator (SRTT + RTTVAR EWMAs), one per peer.
+
+Consumers scale their fixed timeouts from the observed estimates instead of
+reconnect-thrashing distant-but-healthy peers:
+
+  * the node watchdog stretches its stall ladder (`Node._protocol_watchdog`)
+    so strike escalation on a 200 ms-RTT link does not fire on a schedule
+    tuned for loopback;
+  * the block synchronizer widens its per-request timeout to the serving
+    peer's RTO;
+  * `NetworkManager.reconnect_peers` rations strike-3 forced reconnects
+    through a per-peer token bucket refilled on an RTT-scaled interval.
+
+Observed RTTs include send-worker batching delay (flush interval, backoff)
+on both sides by construction — that is the latency consensus traffic
+actually experiences, which is exactly the number recovery should adapt to.
+
+Clock discipline: all reads are `time.monotonic()` (injectable for tests);
+this module is listed under the repo determinism lint's rule D scope
+(tools/check_invariants.py DETERMINISTIC_FILES) so wall-clock reads can
+never creep in.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..utils import metrics
+
+# RFC 6298 smoothing gains
+ALPHA = 0.125  # SRTT gain
+BETA = 0.25    # RTTVAR gain
+
+# bound the metrics label space (utils/metrics caps label sets per family;
+# a gossip-discovered peer flood must not evict the validator gauges)
+MAX_TRACKED_PEERS = 128
+
+
+class PeerRtt:
+    """One peer's smoothed estimate."""
+
+    __slots__ = ("srtt", "rttvar", "samples", "last_sent")
+
+    def __init__(self) -> None:
+        self.srtt: Optional[float] = None
+        self.rttvar: float = 0.0
+        self.samples: int = 0
+        self.last_sent: Optional[float] = None
+
+    def observe(self, sample: float) -> None:
+        if self.srtt is None:
+            self.srtt = sample
+            self.rttvar = sample / 2.0
+        else:
+            self.rttvar = (1 - BETA) * self.rttvar + BETA * abs(
+                self.srtt - sample
+            )
+            self.srtt = (1 - ALPHA) * self.srtt + ALPHA * sample
+        self.samples += 1
+
+
+class RttTracker:
+    """Per-peer SRTT/RTTVAR over the ping_request/ping_reply exchange.
+
+    Pairing is last-sent: with one outstanding ping per peer per second and
+    sub-second RTTs this is exact; when pings overlap, the estimate biases
+    low by at most one ping interval — acceptable for timeout scaling,
+    which only needs the order of magnitude."""
+
+    def __init__(self, *, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._peers: Dict[bytes, PeerRtt] = {}
+
+    def _peer(self, peer: bytes) -> Optional[PeerRtt]:
+        ent = self._peers.get(peer)
+        if ent is None:
+            if len(self._peers) >= MAX_TRACKED_PEERS:
+                return None
+            ent = self._peers[peer] = PeerRtt()
+        return ent
+
+    # -- measurement hooks (NetworkManager) ---------------------------------
+
+    def note_sent(self, peer: bytes, now: Optional[float] = None) -> None:
+        """A ping_request was enqueued toward `peer`."""
+        ent = self._peer(peer)
+        if ent is not None:
+            ent.last_sent = self._clock() if now is None else now
+
+    def note_reply(
+        self, peer: bytes, now: Optional[float] = None
+    ) -> Optional[float]:
+        """A ping_reply arrived from `peer`; returns the RTT sample taken,
+        None when no send was stamped (unsolicited or overflow peer)."""
+        ent = self._peers.get(peer)
+        if ent is None or ent.last_sent is None:
+            return None
+        t = self._clock() if now is None else now
+        sample = t - ent.last_sent
+        ent.last_sent = None
+        if sample < 0:
+            return None
+        ent.observe(sample)
+        metrics.set_gauge(
+            "network_peer_rtt_ms",
+            round(sample * 1000.0, 3),
+            labels={"peer": peer[:4].hex()},
+        )
+        metrics.set_gauge(
+            "network_rtt_max_ms", round(self.max_srtt() * 1000.0, 3)
+        )
+        return sample
+
+    # -- estimates ----------------------------------------------------------
+
+    def srtt(self, peer: bytes) -> Optional[float]:
+        ent = self._peers.get(peer)
+        return ent.srtt if ent is not None else None
+
+    def rto(
+        self, peer: bytes, *, floor: float = 0.2, cap: float = 30.0
+    ) -> float:
+        """RFC 6298 retransmission timeout: SRTT + 4*RTTVAR, clamped to
+        [floor, cap]. An unmeasured peer gets the floor — unknown peers must
+        not inflate timeouts."""
+        ent = self._peers.get(peer)
+        if ent is None or ent.srtt is None:
+            return floor
+        return min(cap, max(floor, ent.srtt + 4.0 * ent.rttvar))
+
+    def max_srtt(self) -> float:
+        """The slowest measured peer's SRTT (0.0 with no samples) — the
+        fleet-wide pessimistic bound timeout scaling keys off: graceful
+        degradation must hold for the farthest region, not the median."""
+        vals = [e.srtt for e in self._peers.values() if e.srtt is not None]
+        return max(vals) if vals else 0.0
+
+    def scale(
+        self, base: float, *, mult: float = 20.0, cap_mult: float = 4.0
+    ) -> float:
+        """An RTT-adaptive timeout: `base` on fast links, stretched toward
+        `mult * max_srtt` as links get slower, never past `cap_mult * base`
+        (adaptivity widens patience, it must not disable the watchdog)."""
+        return min(cap_mult * base, max(base, mult * self.max_srtt()))
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-peer estimate table for health/era reports (peer key = first
+        4 pubkey bytes, the fleet-trace node naming convention)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for peer, ent in self._peers.items():
+            if ent.srtt is None:
+                continue
+            out[peer[:4].hex()] = {
+                "srtt_ms": round(ent.srtt * 1000.0, 3),
+                "rttvar_ms": round(ent.rttvar * 1000.0, 3),
+                "samples": ent.samples,
+            }
+        return out

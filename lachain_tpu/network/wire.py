@@ -342,6 +342,87 @@ TRACE_TRAILER_MAGIC = b"LTRC"
 TRACE_TRAILER_VERSION = 1
 TRACE_TRAILER_LEN = 4 + 1 + 8 + 8 + 8
 
+# Wire/engine version handshake (rolling upgrades): the LTRC trick,
+# generalized. A second fixed-width block rides in the same
+# ignored-by-old-decoders tail region of `content`, BEFORE the trace
+# trailer (the trailer must stay the outermost suffix: legacy
+# `trace_trailer()` parses the last 29 bytes unconditionally, so any block
+# appended after it would break trace parsing on un-upgraded peers).
+# Tail layout, outermost last:
+#   <zlib stream> [LTRX handshake, 13 bytes] [LTRC trailer, 29 bytes]
+# Handshake layout (13 bytes):
+#   magic "LTRX" (4) | hs version 0x01 (1) | wire_version u16 |
+#   engine_version u16 | feature bits u32
+# Signed for free (batch signature covers content), invisible to
+# pre-handshake decoders, and piggybacked on every batch — no extra
+# round-trip, and a restarted peer's version is re-learned on its first
+# frame.
+HANDSHAKE_MAGIC = b"LTRX"
+HANDSHAKE_VERSION = 1
+HANDSHAKE_LEN = 4 + 1 + 2 + 2 + 4
+
+# The compatibility matrix. WIRE_VERSION is the frame/kind vocabulary;
+# ENGINE_VERSION is the consensus engine generation (informational — mixed
+# engines are expected mid-upgrade and never gate traffic). The contract
+# that makes node-by-node rolling upgrades safe is ADJACENCY: version v
+# interoperates with v±1, so a fleet may straddle two consecutive wire
+# versions during a roll but never three. Skipping a wire version requires
+# two rolls.
+WIRE_VERSION = 2  # v1 = pre-handshake (implicit); v2 adds LTRX + snapshots
+ENGINE_VERSION = 1
+MIN_COMPAT_WIRE_VERSION = 1
+
+# feature bits (advertised capabilities, not gates)
+FEATURE_TRACE_TRAILER = 1 << 0
+FEATURE_SNAPSHOT_SYNC = 1 << 1
+FEATURES_DEFAULT = FEATURE_TRACE_TRAILER | FEATURE_SNAPSHOT_SYNC
+
+# Minimum wire version that understands each kind. Kinds absent from a
+# peer's vocabulary raise in its decode_from — so a sender must not emit
+# them toward a peer that has ADVERTISED an older version. Peers that have
+# never advertised (legacy, pre-handshake) are assumed version 1.
+KIND_MIN_WIRE = {k: 1 for k in PRIORITY}
+KIND_MIN_WIRE[KIND_SNAPSHOT_REQUEST] = 2
+KIND_MIN_WIRE[KIND_SNAPSHOT_REPLY] = 2
+
+
+def compatible(a: int, b: int) -> bool:
+    """True iff wire versions `a` and `b` may share a link (adjacency
+    contract: |a-b| <= 1)."""
+    return abs(a - b) <= 1
+
+
+@dataclass(frozen=True)
+class WireHandshake:
+    """A peer's advertised versions, parsed off its batch tail."""
+
+    wire_version: int
+    engine_version: int
+    features: int
+
+    def encode(self) -> bytes:
+        return (
+            HANDSHAKE_MAGIC
+            + bytes([HANDSHAKE_VERSION])
+            + self.wire_version.to_bytes(2, "big")
+            + self.engine_version.to_bytes(2, "big")
+            + self.features.to_bytes(4, "big")
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> Optional["WireHandshake"]:
+        if (
+            len(raw) != HANDSHAKE_LEN
+            or raw[:4] != HANDSHAKE_MAGIC
+            or raw[4] != HANDSHAKE_VERSION
+        ):
+            return None
+        return cls(
+            wire_version=int.from_bytes(raw[5:7], "big"),
+            engine_version=int.from_bytes(raw[7:9], "big"),
+            features=int.from_bytes(raw[9:13], "big"),
+        )
+
 
 def node_trace_origin(pub: bytes) -> bytes:
     """8-byte node lane id for the fleet trace (stable per pubkey)."""
@@ -422,6 +503,21 @@ class MessageBatch:
         era = int.from_bytes(tail[13:21], "big", signed=True)
         return origin, era, tail[21:29]
 
+    def handshake(self) -> Optional[WireHandshake]:
+        """Parse the optional version-handshake block, or None when absent.
+        O(1) suffix reads, like trace_trailer(): the block sits either at
+        the very end of content (no trailer on this batch) or immediately
+        before the 29-byte trace trailer."""
+        c = self.content
+        for off in (len(c) - HANDSHAKE_LEN,
+                    len(c) - HANDSHAKE_LEN - TRACE_TRAILER_LEN):
+            if off < 0:
+                continue
+            hs = WireHandshake.decode(c[off:off + HANDSHAKE_LEN])
+            if hs is not None:
+                return hs
+        return None
+
 
 class MessageFactory:
     """Builds + signs message batches (reference MessageFactory.cs:13-103)."""
@@ -434,10 +530,25 @@ class MessageFactory:
         # decoders); tests flip it off to model a pre-trailer sender
         self.trace_trailer = True
         self._origin = node_trace_origin(self.public_key)
+        # version handshake: advertised on every batch. Tests and the
+        # rolling-upgrade drill flip `handshake` off (or the versions
+        # down) to model a legacy / mid-upgrade sender
+        self.handshake = True
+        self.wire_version = WIRE_VERSION
+        self.engine_version = ENGINE_VERSION
+        self.features = FEATURES_DEFAULT
 
     def batch(self, msgs: List[NetworkMessage]) -> MessageBatch:
         raw = write_u32(len(msgs)) + b"".join(m.encode() for m in msgs)
         content = zlib.compress(raw, level=1)
+        if self.handshake:
+            # before the trace trailer: the trailer must stay the
+            # outermost suffix (see tail layout at HANDSHAKE_MAGIC)
+            content += WireHandshake(
+                wire_version=self.wire_version,
+                engine_version=self.engine_version,
+                features=self.features,
+            ).encode()
         if self.trace_trailer:
             # era = the newest era among the batch's consensus messages
             # (a flush batch can mix eras under pipelining; the receiver's

@@ -60,6 +60,10 @@ class ClientWorker:
         self._stopped = False
         self._queued_bytes = 0
         self._backoff = flush_interval
+        # WAN hint (manager/rtt): redial pacing should start near the
+        # link's actual RTT — on a 300 ms link a flush-interval-paced
+        # first retry burns a dial that cannot have completed yet
+        self.backoff_floor = 0.0
         self.consecutive_failures = 0
         # ±25% reconnect jitter, seeded per (us, peer) pair: deterministic
         # for replay, yet different across peers — after a relay blip every
@@ -158,10 +162,11 @@ class ClientWorker:
                         # ordering within a priority is preserved
                         self._queues[PRIORITY[m.kind]].appendleft(m)
                         self._queued_bytes += len(m.body) + 6
+                    pause = max(self._backoff, self.backoff_floor)
                     await asyncio.sleep(
-                        self._backoff * (0.75 + 0.5 * self._jitter.random())
+                        pause * (0.75 + 0.5 * self._jitter.random())
                     )
-                    self._backoff = min(self._backoff * 2, BACKOFF_MAX)
+                    self._backoff = min(pause * 2, BACKOFF_MAX)
                     break
         # final flush on stop
         if self._pending():

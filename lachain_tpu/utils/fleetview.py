@@ -163,6 +163,13 @@ def merge_traces(nodes: List[Dict[str, object]]) -> dict:
                 "uncertaintyUs": offset.get("uncertainty_us"),
                 "wallSkewUs": offset.get("wall_skew_us"),
                 "status": health.get("status"),
+                # WAN posture (PR 18): worst peer SRTT, the RTT-scaled
+                # stall budget actually in force, and the node's wire
+                # version — a mixed-version fleet mid-rolling-upgrade is
+                # visible here without shelling into nodes
+                "rttMaxMs": health.get("rttMaxMs"),
+                "stallTimeoutEffective": health.get("stallTimeoutEffective"),
+                "wireVersion": health.get("wireVersion"),
                 "errors": node.get("errors") or {},
             }
         )

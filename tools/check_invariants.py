@@ -75,7 +75,13 @@ PACKAGE = "lachain_tpu"
 
 # rule D applies to these path prefixes/files (relative to the package root)
 DETERMINISTIC_PREFIXES = ("consensus/",)
-DETERMINISTIC_FILES = ("core/parallel_exec.py", "storage/trie.py")
+DETERMINISTIC_FILES = (
+    "core/parallel_exec.py",
+    "storage/trie.py",
+    # RTT estimation feeds consensus-adjacent timeout scaling: monotonic
+    # clocks are fine (injected for tests), wall clock is not
+    "network/rtt.py",
+)
 
 # wall-clock attribute calls banned under rule D: module-alias . attr
 WALL_CLOCK = {
