@@ -9,7 +9,8 @@ PYTEST_ARGS ?= -q
 
 .PHONY: test test-kernel test-fast test-chaos test-byzantine test-storage \
 	test-observability test-sync test-pipeline test-exec test-trie \
-	test-mesh test-wan native bench bench-gate lint sanitize sanitize-tsan
+	test-mesh test-wan test-rs native bench bench-gate lint sanitize \
+	sanitize-tsan
 
 # crypto/accelerator kernels: BLS12-381 group law + subgroup checks,
 # TPKE, threshold signatures, JAX ops, kernel cache, native C++ backend.
@@ -18,6 +19,13 @@ PYTEST_ARGS ?= -q
 # re-pays them
 test-kernel:
 	$(PYTEST) $(PYTEST_ARGS) -m "kernel and not mesh"
+
+# batched Reed-Solomon engine (ops/rs_batch.py + consensus/rbc_batcher.py):
+# 200-seed scalar-vs-batch differentials, GF(2^16) codec, era-batcher
+# dedupe/memo semantics, stale-.so fallback, on-vs-off block-hash identity
+# on both engines. The slice to run after touching RBC or the RS codecs.
+test-rs:
+	$(PYTEST) $(PYTEST_ARGS) tests/test_rs_batch.py
 
 # everything that is neither a kernel test nor a fault-injection run:
 # consensus, storage, network, RPC, node lifecycle — the quick sanity

@@ -84,6 +84,14 @@ def main() -> None:
         "backend selection",
     )
     ap.add_argument(
+        "--rbc-batch",
+        type=int,
+        default=1,
+        help="1 = batch all pending RBC encode/interpolate codec work per "
+        "era into fused GF matrix products (ops/rs_batch.py via "
+        "consensus/rbc_batcher.py); 0 = per-message ops/rs.py path",
+    )
+    ap.add_argument(
         "--overhead-check",
         action="store_true",
         help="after the timed eras, re-run the same era count with the "
@@ -153,6 +161,7 @@ def main() -> None:
         txs_per_block=args.txs,
         engine=args.engine,
         pipeline_window=args.pipeline_window,
+        rbc_batch=bool(args.rbc_batch),
     )
 
     def _exec_total_s() -> float:
@@ -277,6 +286,12 @@ def main() -> None:
 
     best = min(range(len(times)), key=lambda i: times[i])
     era_s = times[best]
+    # gateable per-era phase splits (compare.py LATENCY_FIELDS): the rbc
+    # column the batched codec shrinks and the idle the overlap removes,
+    # taken from the fastest timed era's flight-recorder row
+    best_phase = phase_report.get(best + 1, {})
+    rbc_s = best_phase.get("rbc", 0.0) + best_phase.get("rbc_device", 0.0)
+    idle_s = best_phase.get("idle_s", 0.0)
     redundant_s = exec_times[best] * (n - 1) / n
     normalized_s = max(0.0, era_s - redundant_s)
     print(
@@ -289,6 +304,9 @@ def main() -> None:
                 "f": f,
                 "engine": args.engine,
                 "pipeline_window": args.pipeline_window,
+                "rbc_batch": int(args.rbc_batch),
+                "rbc_s": round(rbc_s, 3),
+                "idle_s": round(idle_s, 3),
                 "txs_per_era": total_txs // args.eras,
                 "tx_per_s": round(total_txs / sum(times), 1),
                 "per_node_normalized_latency_s": round(normalized_s, 3),

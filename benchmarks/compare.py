@@ -55,6 +55,13 @@ LATENCY_FIELDS = (
     # when both runs report them, so pre-18 baselines stay valid.
     "era_latency_p99_s",
     "rtt_ms",
+    # RBC batching (PR 20, bench_consensus_sim): the fastest era's RBC codec
+    # phase (host + device RS time) and its idle remainder — the two columns
+    # the batched Reed-Solomon engine and the flush overlap exist to shrink.
+    # Only compared when both runs report them, so pre-20 baselines stay
+    # valid.
+    "rbc_s",
+    "idle_s",
 )
 
 # throughput-shaped side fields compared higher-is-better when both runs

@@ -57,6 +57,9 @@ class EraRouter(Broadcaster):
         self.public_keys = public_keys
         self.private_keys = private_keys
         self._send = send
+        # era-scoped RS flush batcher (rbc_batcher.py), wired on by the
+        # network when batching is enabled; None = inline codec calls
+        self.rbc_batcher = None
         self._protocols: Dict[Any, Protocol] = {}
         self._extra_factories = extra_factories or {}
         self.terminated = False

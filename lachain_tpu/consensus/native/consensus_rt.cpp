@@ -448,6 +448,10 @@ enum CrossOp {
   XO_ROOT_PRODUCE = 10,  // assemble multisig + produce the block
   XO_EVIDENCE = 11,      // a=offender b=opq_kind blob=be32 agreement+epoch:
                          // conflicting payloads in one first-seen slot
+  XO_RBC_ENCODE = 12,    // a=slot blob=proposal: host RS-encodes + merkles,
+                         // answers PO_RBC_VALS (batched RBC host shim)
+  XO_RBC_NEED = 13,      // a=slot blob=root(32)+[(u32 idx,u32 len,shard)...]:
+                         // host interpolates + rechecks, answers PO_RBC_RESULT
 };
 
 // Python -> engine post ops (rt_post `op`).
@@ -466,6 +470,11 @@ enum PostOp {
   PO_ROOT_HEADER = 12,  // blob = be32 own_len | own bytes | broadcast bytes
   PO_ROOT_ACCEPT = 13,  // a=sender: header signature verified
   PO_ROOT_REJECT = 14,  // a=sender: invalid signature (sender may retry)
+  PO_RBC_VALS = 15,     // a=slot blob = be32 era | root(32) | be32 n |
+                        //   per-i (be32 nbranch | (be32 len|hash)* |
+                        //   be32 shard_len | shard): engine builds VAL fan-out
+  PO_RBC_RESULT = 16,   // a=slot b=ok blob = be32 era | root(32) | payload:
+                        //   host interpolation verdict (ok=0 -> bad root)
 };
 
 // rt_request kinds (Python-side divert of era.py::internal_request).
@@ -556,6 +565,10 @@ struct RBC {
     std::vector<std::string> shards;  // n entries, empty = missing
     int have = 0;
     Bits ready;
+    // host-shim mode: an interpolation for this root crossed to the host
+    // batcher and its PO_RBC_RESULT has not landed yet (suppresses
+    // re-submission while more echoes arrive)
+    bool interp_pending = false;
   };
   std::unordered_map<std::string, PerRoot> roots;
   std::vector<std::pair<std::string, std::string>> payloads;  // insertion order
@@ -815,6 +828,9 @@ struct Engine {
   int coin_need = 0;               // ts_keys.t + 1 (set from Python)
   uint64_t native_handled = 0;     // opaque deliveries handled without Python
   int hb_queued_count = 0;         // native HBs with a queued batcher build
+  bool rbc_host = false;  // RBC RS+Merkle math diverted to the host shim
+                          // (XO_RBC_* / PO_RBC_*); engine-internal
+                          // rs_encode/rs_decode stay the no-host fallback
   opaque_cb_t cb_opaque = nullptr;
   acs_cb_t cb_acs = nullptr;
   coinreq_cb_t cb_coinreq = nullptr;
@@ -1422,6 +1438,12 @@ void RBC::on_request(bool has_value, const std::string& value) {
     terminated = true;  // Python raises ValueError -> protocol terminated
     return;
   }
+  if (E->rbc_host) {
+    // host shim owns the RS math: queue the encode with the era batcher;
+    // the VAL fan-out arrives back as one PO_RBC_VALS post
+    E->cross(vid, XO_RBC_ENCODE, slot, 0, value);
+    return;
+  }
   std::vector<std::string> shards = rs_encode(value, k(), E->n);
   std::vector<std::string> leaves(E->n);
   for (int i = 0; i < E->n; i++) leaves[i] = keccak_s(shards[i]);
@@ -1465,6 +1487,11 @@ void RBC::on_val(int sender, const Msg& m) {
 
 void RBC::on_echo(int sender, const Msg& m) {
   if (m.shard_index != sender) return;  // each validator echoes its own shard
+  // duplicate check BEFORE the branch proof: re-delivered echoes must not
+  // pay keccak + Merkle verification again (find, not per_root, so bogus
+  // roots allocate nothing pre-verification)
+  auto it = roots.find(m.root);
+  if (it != roots.end() && !it->second.shards[sender].empty()) return;
   if (!check_branch(m)) return;
   PerRoot& pr = per_root(m.root);
   if (!pr.shards[sender].empty()) return;
@@ -1494,6 +1521,24 @@ void RBC::try_interpolate(const std::string& root) {
   if (payload_of(root) || bad_roots.count(root)) return;
   PerRoot& pr = per_root(root);
   if (pr.have < E->n - 2 * E->f) return;
+  if (E->rbc_host) {
+    // host shim owns the interpolate + re-encode + Merkle recheck: ship the
+    // first-k present shards (the same selection rs_decode makes) and wait
+    // for the PO_RBC_RESULT verdict. Later echoes cannot change it.
+    if (pr.interp_pending) return;
+    pr.interp_pending = true;
+    std::string blob = root;
+    int need = k(), taken = 0;
+    for (int i = 0; i < E->n && taken < need; i++) {
+      if (pr.shards[i].empty()) continue;
+      put_be32(blob, (uint32_t)i);
+      put_be32(blob, (uint32_t)pr.shards[i].size());
+      blob += pr.shards[i];
+      taken++;
+    }
+    E->cross(vid, XO_RBC_NEED, slot, 0, blob);
+    return;
+  }
   std::string payload;
   if (!rs_decode(pr.shards, k(), payload)) {
     bad_roots.insert(root);
@@ -1862,6 +1907,74 @@ void Engine::native_post(int vid, int op, int a, int b, const uint8_t* data,
       if (r) r->pending_bits.clr(a);  // sender may retry (oracle re-verifies)
       break;
     }
+    case PO_RBC_VALS: {
+      // host shim answered XO_RBC_ENCODE: build the VAL fan-out exactly as
+      // RBC::on_request would. The be32 era prefix drops posts that raced
+      // an era advance (the flush runs outside the dispatch loop).
+      if (len < 40) break;
+      if ((int)get_be32(data) != V.era) break;  // stale era: drop
+      std::string root = blob.substr(4, 32);
+      size_t off = 36;
+      uint32_t n_sh = get_be32(data + off);
+      off += 4;
+      if ((int)n_sh != n) break;
+      for (uint32_t i = 0; i < n_sh; i++) {
+        if (off + 4 > len) return;
+        uint32_t nbranch = get_be32(data + off);
+        off += 4;
+        std::vector<std::string> branch(nbranch);
+        for (uint32_t j = 0; j < nbranch; j++) {
+          if (off + 4 > len) return;
+          uint32_t bl = get_be32(data + off);
+          off += 4;
+          if (off + bl > len) return;
+          branch[j] = blob.substr(off, bl);
+          off += bl;
+        }
+        if (off + 4 > len) return;
+        uint32_t sl = get_be32(data + off);
+        off += 4;
+        if (off + sl > len) return;
+        Msg* m = new Msg();
+        m->type = MT_VAL;
+        m->era = V.era;
+        m->agreement = a;
+        m->root = root;
+        m->branch = std::move(branch);
+        m->data = blob.substr(off, sl);
+        off += sl;
+        m->shard_index = (int)i;
+        sendto(vid, (int)i, m);
+      }
+      break;
+    }
+    case PO_RBC_RESULT: {
+      // host shim answered XO_RBC_NEED: settle the interpolation verdict
+      // exactly as the tail of RBC::try_interpolate would (b=0 -> bad root)
+      if (len < 36) break;
+      if ((int)get_be32(data) != V.era) break;  // stale era: drop
+      std::string root = blob.substr(4, 32);
+      RBC* r = get_rbc(V, a, false);
+      if (!r) break;
+      r->per_root(root).interp_pending = false;
+      if (r->payload_of(root) || r->bad_roots.count(root)) break;
+      if (!b) {
+        r->bad_roots.insert(root);
+        break;
+      }
+      r->payloads.emplace_back(root, blob.substr(36));
+      if (!r->ready_sent) {
+        r->ready_sent = true;
+        Msg* m = new Msg();
+        m->type = MT_READY;
+        m->era = V.era;
+        m->agreement = a;
+        m->root = root;
+        bcast(vid, m);
+      }
+      r->try_deliver();
+      break;
+    }
   }
 }
 
@@ -2174,7 +2287,7 @@ void NRoot::maybe_verify() {
 
 extern "C" {
 
-int lt_crt_version() { return 6; }
+int lt_crt_version() { return 7; }
 
 // Engines are single-threaded by contract: one engine = one queue = one
 // dispatch loop. The pipelined era window (native_rt.py) therefore runs ONE
@@ -2211,6 +2324,14 @@ void rt_set_owned(void* h, int vid, int mask) {
 
 void rt_set_coin_need(void* h, int need) {
   static_cast<Engine*>(h)->coin_need = need;
+}
+
+// Divert RBC's RS+Merkle math to the host shim (XO_RBC_ENCODE/XO_RBC_NEED
+// crossings answered by PO_RBC_VALS/PO_RBC_RESULT posts). Added in version
+// 7: a binding probing an older .so falls back to the engine-internal
+// per-message codec path (native_rt.py::load_rt version probe).
+void rt_set_rbc_host(void* h, int enabled) {
+  static_cast<Engine*>(h)->rbc_host = enabled != 0;
 }
 
 void rt_request(void* h, int vid, int kind, int a, int b) {

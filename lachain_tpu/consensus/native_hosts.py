@@ -37,6 +37,8 @@ XO_ROOT_SIGN = 8
 XO_ROOT_VERIFY = 9
 XO_ROOT_PRODUCE = 10
 XO_EVIDENCE = 11
+XO_RBC_ENCODE = 12
+XO_RBC_NEED = 13
 
 XO_NAMES = {
     XO_COIN_SIGN: "coin_sign",
@@ -50,6 +52,8 @@ XO_NAMES = {
     XO_ROOT_VERIFY: "root_verify",
     XO_ROOT_PRODUCE: "root_produce",
     XO_EVIDENCE: "evidence",
+    XO_RBC_ENCODE: "rbc_encode",
+    XO_RBC_NEED: "rbc_need",
 }
 
 # Python -> engine post ops
@@ -67,6 +71,8 @@ PO_HB_REQUEUE_CHECK = 11
 PO_ROOT_HEADER = 12
 PO_ROOT_ACCEPT = 13
 PO_ROOT_REJECT = 14
+PO_RBC_VALS = 15
+PO_RBC_RESULT = 16
 
 # rt_request kinds
 RQ_HB = 1
@@ -535,3 +541,94 @@ class RootHost:
         # like internal_response(to_id=None) does for Python protocols
         self.router._net._request_stop(era=self.id.era)
         return block
+
+
+class RbcHost:
+    """RS + Merkle half of the native ReliableBroadcast (version 7 boundary
+    op). The engine keeps the full Bracha message state machine (VAL/ECHO/
+    READY dedupe, thresholds, delivery) and crosses out only the codec work:
+    XO_RBC_ENCODE for the sender-side shard fan-out, XO_RBC_NEED for the
+    interpolate + re-encode + root-recheck verdict. Both run through the
+    era RBC batcher (rbc_batcher.py) when one is wired on, so every
+    validator's pending codec work in an era fuses into one batched matrix
+    product — and the per-(root, k, n) verdict memo collapses the N
+    in-process validators' identical interpolations into one."""
+
+    def __init__(self, router, era: int):
+        self.router = router
+        self.era = era
+        self.me = router.my_id
+        self.n = router.n_validators
+        self.f = router.f
+        self.k = max(self.n - 2 * self.f, 1)
+
+    @property
+    def _batcher(self):
+        return self.router.rbc_batcher
+
+    # XO_RBC_ENCODE — reliable_broadcast.py::handle_input codec half
+    def on_encode(self, slot: int, value: bytes) -> None:
+        batcher = self._batcher
+        if batcher is not None:
+            batcher.submit_encode(
+                self.era,
+                value,
+                self.k,
+                self.n,
+                lambda shards, _slot=slot: self._post_vals(_slot, shards),
+            )
+            return
+        from ..ops import rs
+
+        self._post_vals(slot, rs.encode(value, self.k, self.n))
+
+    def _post_vals(self, slot: int, shards) -> None:
+        from ..crypto import hashes
+
+        leaves = hashes.keccak256_batch(shards)
+        root = hashes.merkle_root(leaves)
+        blob = bytearray(self.era.to_bytes(4, "big"))
+        blob += root
+        blob += self.n.to_bytes(4, "big")
+        for i in range(self.n):
+            branch = hashes.merkle_proof(leaves, i)
+            blob += len(branch).to_bytes(4, "big")
+            for h in branch:
+                blob += len(h).to_bytes(4, "big")
+                blob += h
+            blob += len(shards[i]).to_bytes(4, "big")
+            blob += shards[i]
+        self.router._net._rt_post(
+            self.me, PO_RBC_VALS, slot, 0, bytes(blob), era=self.era
+        )
+
+    # XO_RBC_NEED — reliable_broadcast.py::_try_interpolate codec half
+    def on_need(self, slot: int, blob: bytes) -> None:
+        root = blob[:32]
+        full = [None] * self.n
+        for idx, shard in iter_pairs(blob[32:]):
+            if 0 <= idx < self.n:
+                full[idx] = shard
+        batcher = self._batcher
+        if batcher is not None:
+            batcher.submit_interpolate(
+                self.era,
+                full,
+                self.k,
+                self.n,
+                root,
+                lambda payload, _slot=slot, _root=root: self._post_result(
+                    _slot, _root, payload
+                ),
+            )
+            return
+        from .rbc_batcher import scalar_verdict
+
+        self._post_result(slot, root, scalar_verdict(full, self.k, root))
+
+    def _post_result(self, slot: int, root: bytes, payload) -> None:
+        ok = 1 if payload is not None else 0
+        blob = self.era.to_bytes(4, "big") + root + (payload or b"")
+        self.router._net._rt_post(
+            self.me, PO_RBC_RESULT, slot, ok, blob, era=self.era
+        )

@@ -46,12 +46,15 @@ from .native_hosts import (
     XO_HB_DONE,
     XO_HB_QUEUE,
     XO_NAMES,
+    XO_RBC_ENCODE,
+    XO_RBC_NEED,
     XO_ROOT_INPUT,
     XO_ROOT_PRODUCE,
     XO_ROOT_SIGN,
     XO_ROOT_VERIFY,
     CoinHost,
     HoneyBadgerHost,
+    RbcHost,
     RootHost,
 )
 from .simulator import DeliveryMode
@@ -132,7 +135,8 @@ def load_rt():
             )
     lib = ctypes.CDLL(lib_path)
     lib.lt_crt_version.restype = ctypes.c_int
-    assert lib.lt_crt_version() == 6
+    _crt_ver = lib.lt_crt_version()
+    assert _crt_ver in (6, 7), _crt_ver
     lib.rt_new.restype = ctypes.c_void_p
     lib.rt_new.argtypes = [
         ctypes.c_int,
@@ -152,6 +156,12 @@ def load_rt():
     ]
     lib.rt_set_owned.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     lib.rt_set_coin_need.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # version 7 added the batched RBC boundary (XO_RBC_ENCODE/NEED). Probe it
+    # so a stale .so built from older sources degrades to the engine's
+    # per-message RBC path instead of crashing (keccak_batch-style fallback).
+    lib._lt_has_rbc_host = _crt_ver >= 7 and hasattr(lib, "rt_set_rbc_host")
+    if lib._lt_has_rbc_host:
+        lib.rt_set_rbc_host.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.rt_request.argtypes = [
         ctypes.c_void_p,
         ctypes.c_int,
@@ -370,12 +380,13 @@ class NativeCoinParent:
 class _EraHosts:
     """Per-era container for the native-protocol host shims of one router."""
 
-    __slots__ = ("coins", "hb", "root", "py_parents")
+    __slots__ = ("coins", "hb", "root", "rbc", "py_parents")
 
     def __init__(self):
         self.coins: Dict[tuple, CoinHost] = {}
         self.hb: Optional[HoneyBadgerHost] = None
         self.root: Optional[RootHost] = None
+        self.rbc: Optional[RbcHost] = None
         # parent protocol ids of PYTHON protocols awaiting a native result
         self.py_parents: Dict[Any, Any] = {}
 
@@ -417,6 +428,7 @@ class NativeEraRouter(EraRouter):
         self._net = net
         self._acs_parent: Any = None
         self.crypto_batcher = None  # set by the network when batching is on
+        self.rbc_batcher = None  # set by the network when RBC batching is on
         self._root_ctx = None  # (producer, ecdsa_priv, ecdsa_pubs)
         self._era_hosts: Dict[int, _EraHosts] = {}
         self._native_results: Dict[Any, Any] = {}
@@ -467,6 +479,12 @@ class NativeEraRouter(EraRouter):
             cid = M.CoinId(era=era, agreement=agreement, epoch=epoch)
             host = hs.coins[key] = CoinHost(self, cid)
         return host
+
+    def rbc_host(self, era: int) -> RbcHost:
+        hs = self._hosts(era)
+        if hs.rbc is None:
+            hs.rbc = RbcHost(self, era)
+        return hs.rbc
 
     def root_host(self, era: int) -> RootHost:
         hs = self._hosts(era)
@@ -751,6 +769,10 @@ class NativeEraRouter(EraRouter):
                     super().internal_response(
                         M.Result(from_id=hbid, to_id=parent, value=result)
                     )
+        elif op == XO_RBC_ENCODE:
+            self.rbc_host(era).on_encode(a, blob)
+        elif op == XO_RBC_NEED:
+            self.rbc_host(era).on_need(a, blob)
         elif op == XO_ROOT_INPUT:
             self.root_host(era).on_input()
         elif op == XO_ROOT_SIGN:
@@ -796,6 +818,7 @@ class NativeSimulatedNetwork:
         muted: Optional[Set[int]] = None,
         extra_factories=None,
         use_crypto_batcher: bool = True,
+        use_rbc_batcher: bool = False,
         fault_plan=None,
         journals: Optional[List] = None,
         pipeline_window: int = 0,
@@ -920,6 +943,24 @@ class NativeSimulatedNetwork:
             self.crypto_batcher = TpkeEraBatcher()
             for r in self.routers:
                 r.crypto_batcher = self.crypto_batcher
+        # era-scoped RBC codec batcher (rbc_batcher.py): opt-in, and only
+        # when the .so exports the version-7 RBC host boundary — a stale
+        # library degrades to the engine's per-message RS path. LACHAIN_RBC_BATCH=0
+        # force-disables it even when requested (ops kill switch).
+        self.rbc_batcher = None
+        self._rbc_host_on = False
+        if (
+            use_rbc_batcher
+            and self._lib._lt_has_rbc_host
+            and os.environ.get("LACHAIN_RBC_BATCH", "1") != "0"
+        ):
+            from .rbc_batcher import RbcEraBatcher
+
+            self.rbc_batcher = RbcEraBatcher()
+            self._rbc_host_on = True
+            for r in self.routers:
+                r.rbc_batcher = self.rbc_batcher
+            self._lib.rt_set_rbc_host(self._h, 1)
         self._own_masks = [-1] * self.n  # engine-side mask cache (-1 unset)
         self._sync_ownership()
         # flight recorder: size the engine ring, align its clock with
@@ -982,6 +1023,8 @@ class NativeSimulatedNetwork:
         for v in self.muted:
             self._lib.rt_mute(h, v)
         self._lib.rt_set_coin_need(h, self._coin_need)
+        if self._rbc_host_on:
+            self._lib.rt_set_rbc_host(h, 1)
         self._lib.rt_set_callbacks(h, *self._cbs)
         for vid in range(self.n):
             if self._own_masks[vid] >= 0:
@@ -1331,6 +1374,18 @@ class NativeSimulatedNetwork:
                     "consensus_dispatch_queue_depth",
                     self._lib.rt_queue_len(self._h),
                 )
+                # RBC codec batch flushes first: interpolations unblock
+                # READY/deliver and thus ACS, so draining them before the
+                # TPKE flush keeps the later crypto batch as large as it
+                # can possibly get
+                if (
+                    self.rbc_batcher is not None
+                    and self.rbc_batcher.pending
+                    and self._lib.rt_queue_len(self._h) == 0
+                ):
+                    self.rbc_batcher.flush()
+                    self._raise_cb_error()
+                    continue
                 if (
                     self.crypto_batcher is not None
                     and self.crypto_batcher.pending
@@ -1442,6 +1497,14 @@ class NativeSimulatedNetwork:
             metrics.set_gauge(
                 "consensus_dispatch_queue_depth", self._lib.rt_queue_len(h)
             )
+            if (
+                self.rbc_batcher is not None
+                and self.rbc_batcher.pending_for(era)
+                and self._lib.rt_queue_len(h) == 0
+            ):
+                self.rbc_batcher.flush(era)
+                self._raise_cb_error(era)
+                continue
             if (
                 self.crypto_batcher is not None
                 and self.crypto_batcher.pending_for(era)

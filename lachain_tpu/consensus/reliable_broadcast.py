@@ -36,10 +36,20 @@ class ReliableBroadcast(Protocol):
         self._bad_roots: Set[bytes] = set()
         self._delivered = False
         self._val_seen = False
+        # roots with an interpolation submitted to the era RBC batcher and
+        # not yet resolved — suppresses duplicate submissions while further
+        # echoes for the same root keep arriving
+        self._interp_inflight: Set[bytes] = set()
 
     @property
     def _k(self) -> int:
         return max(self.n - 2 * self.f, 1)
+
+    @property
+    def _batcher(self):
+        """The era RBC flush batcher, when the network wired one onto the
+        router (rbc_batcher.py); None means every codec call runs inline."""
+        return getattr(self.broadcaster, "rbc_batcher", None)
 
     # -- sender input --------------------------------------------------------
     def handle_input(self, value: Optional[bytes]) -> None:
@@ -47,7 +57,17 @@ class ReliableBroadcast(Protocol):
             return  # participant-only instance
         if self.id.sender_id != self.me:
             raise ValueError("only the slot's sender may input a payload")
-        shards = rs.encode(value, self._k, self.n)
+        batcher = self._batcher
+        if batcher is not None:
+            # eager-encode: the proposal is queued before the era front so
+            # the first flush codes every validator's proposal in one call
+            batcher.submit_encode(
+                self.id.era, value, self._k, self.n, self._send_vals
+            )
+            return
+        self._send_vals(rs.encode(value, self._k, self.n))
+
+    def _send_vals(self, shards: List[bytes]) -> None:
         leaves = [hashes.keccak256(s) for s in shards]
         root = hashes.merkle_root(leaves)
         for i in range(self.n):
@@ -99,11 +119,15 @@ class ReliableBroadcast(Protocol):
         # each validator echoes exactly its own shard
         if msg.shard_index != sender:
             return
+        # duplicate check BEFORE the branch proof: a re-delivered echo must
+        # not pay keccak + Merkle verification again (the .get keeps bogus
+        # roots from allocating state pre-verification)
+        seen = self._echo.get(msg.root)
+        if seen is not None and sender in seen:
+            return
         if not self._check_branch(msg.root, msg.branch, msg.shard, msg.shard_index):
             return
         slot = self._echo.setdefault(msg.root, {})
-        if sender in slot:
-            return
         slot[sender] = (msg.shard, msg.branch)
         self._try_interpolate(msg.root)
         self._try_deliver()
@@ -136,16 +160,41 @@ class ReliableBroadcast(Protocol):
         full: List[Optional[bytes]] = [None] * self.n
         for idx, (shard, _branch) in slot.items():
             full[idx] = shard
+        batcher = self._batcher
+        if batcher is not None:
+            if root in self._interp_inflight:
+                return  # already queued; later echoes cannot change the verdict
+            self._interp_inflight.add(root)
+            batcher.submit_interpolate(
+                self.id.era,
+                full,
+                self._k,
+                self.n,
+                root,
+                lambda payload, _root=root: self._apply_interpolation(
+                    _root, payload
+                ),
+            )
+            return
         reencoded = rs.reencode(full, self._k)
         if reencoded is None:
-            self._bad_roots.add(root)
+            self._apply_interpolation(root, None)
             return
         # malicious-sender check: recomputed Merkle root must match
         leaves = [hashes.keccak256(s) for s in reencoded]
         if hashes.merkle_root(leaves) != root:
-            self._bad_roots.add(root)  # equivocated shards: never deliver
+            self._apply_interpolation(root, None)  # equivocated shards
             return
-        payload = rs.decode(full, self._k)
+        self._apply_interpolation(root, rs.decode(full, self._k))
+
+    def _apply_interpolation(
+        self, root: bytes, payload: Optional[bytes]
+    ) -> None:
+        """Settle one interpolation verdict (inline or batcher callback):
+        None marks the root bad forever; a payload arms READY + delivery."""
+        self._interp_inflight.discard(root)
+        if root in self._payloads or root in self._bad_roots:
+            return
         if payload is None:
             self._bad_roots.add(root)
             return

@@ -56,6 +56,7 @@ class SimulatedNetwork:
         extra_factories: Optional[Dict[type, Callable]] = None,
         router_cls=EraRouter,
         use_crypto_batcher: bool = True,
+        use_rbc_batcher: bool = False,
         fault_plan=None,
         max_recovery_rounds: int = 16,
     ):
@@ -112,6 +113,17 @@ class SimulatedNetwork:
             self.crypto_batcher = TpkeEraBatcher()
             for r in self.routers:
                 r.crypto_batcher = self.crypto_batcher
+        # router-level RBC flush batcher (rbc_batcher.py): every pending
+        # Reed-Solomon encode/interpolate flushes as one batched matrix
+        # product at quiescence. Opt-in (default off) so seed-pinned
+        # message schedules in existing tests stay byte-identical.
+        self.rbc_batcher = None
+        if use_rbc_batcher:
+            from .rbc_batcher import RbcEraBatcher
+
+            self.rbc_batcher = RbcEraBatcher()
+            for r in self.routers:
+                r.rbc_batcher = self.rbc_batcher
 
     def _make_send(self, sender: int):
         def send(target: Optional[int], payload) -> None:
@@ -214,6 +226,13 @@ class SimulatedNetwork:
                     self._vtime = max(self._vtime, self._delayed[0][0])
                     continue
                 metrics.set_gauge("consensus_dispatch_queue_depth", 0)
+                # RBC before TPKE: interpolation verdicts unblock READY /
+                # delivery traffic that feeds the ACS, whose completions are
+                # what make decrypt-share batches grow — flushing RBC first
+                # keeps the later crypto flush as large as possible
+                if self.rbc_batcher is not None and self.rbc_batcher.pending:
+                    self.rbc_batcher.flush()
+                    continue
                 if self.crypto_batcher is not None and self.crypto_batcher.pending:
                     self.crypto_batcher.flush()
                     continue

@@ -71,6 +71,7 @@ class Devnet:
         merkle_workers: int = 1,
         adversary=None,
         link_shaper=None,
+        rbc_batch: bool = False,
     ):
         # link_shaper (network/faults.py LinkShaper): WAN emulation on the
         # simulated delivery layer — per-region-pair latency/jitter/
@@ -169,12 +170,14 @@ class Devnet:
                 fault_plan=fault_plan,
                 pipeline_window=self.pipeline_window,
                 journals=journals,
+                use_rbc_batcher=rbc_batch,
             )
         else:
             net_cls = SimulatedNetwork
             net_kw = dict(
                 fault_plan=fault_plan,
                 max_recovery_rounds=max_recovery_rounds,
+                use_rbc_batcher=rbc_batch,
             )
             if journals is not None:
                 # the python simulator has no journal hosting; passing one

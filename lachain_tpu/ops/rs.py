@@ -77,15 +77,17 @@ def encode(data: bytes, k: int, n: int) -> List[bytes]:
     polynomial. Returns n shards of equal size.
     """
     assert 0 < k <= n
-    prefixed = len(data).to_bytes(4, "big") + data
     if n > 255:
-        # GF(2^8) has only 255 distinct evaluation points. Past that the
-        # codec degrades to whole-payload replication: every shard is the
-        # full prefixed payload (bandwidth n x |v| instead of the coded
-        # optimum; thresholds and Merkle commitments unchanged). Mirrors
-        # consensus_rt.cpp::rs_encode; GF(2^16) coding is the planned
-        # upgrade (ROADMAP item 1).
-        return [prefixed] * n
+        # GF(2^8) has only 255 distinct evaluation points; past that the
+        # codec switches to GF(2^16) symbols (rs_batch.py) behind the same
+        # API — true coding up to 65535 shards, not the whole-payload
+        # replication this branch used to fall back to. The native engine's
+        # internal rs_encode keeps replication as ITS fallback when no RBC
+        # host shim is attached (consensus_rt.cpp).
+        from . import rs_batch
+
+        return rs_batch.encode(data, k, n)
+    prefixed = len(data).to_bytes(4, "big") + data
     shard_size = (len(prefixed) + k - 1) // k
     padded = prefixed + b"\x00" * (k * shard_size - len(prefixed))
     coeffs = np.frombuffer(padded, dtype=np.uint8).reshape(k, shard_size)
@@ -118,15 +120,12 @@ def decode(shards: Sequence[Optional[bytes]], k: int) -> Optional[bytes]:
     if any(len(s) != size for _, s in have):
         return None
     if n > 255:
-        # replication mode (see encode): every shard IS the prefixed
-        # payload; decode from the first one
-        flat = have[0][1]
-        if len(flat) < 4:
-            return None
-        length = int.from_bytes(flat[:4], "big")
-        if length > len(flat) - 4:
-            return None
-        return flat[4 : 4 + length]
+        # GF(2^16) symbols (see encode): delegate to the batched codec's
+        # single-item path, which applies the same first-k / mixed-size /
+        # length-prefix guards plus the even-byte symbol check
+        from . import rs_batch
+
+        return rs_batch.decode(shards, k)
     xs = [_eval_points(n)[i] for i, _ in have]
     mat = np.zeros((k, k), dtype=np.uint8)  # Vandermonde rows [x^0 .. x^{k-1}]
     for r, x in enumerate(xs):

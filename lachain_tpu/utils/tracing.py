@@ -452,6 +452,7 @@ def summary() -> dict:
 PHASES = (
     "propose",
     "rbc",
+    "rbc_device",
     "ba",
     "coin",
     "tpke_verify",
@@ -475,6 +476,9 @@ _PHASE_PRIORITY = {
     "coin": 6,
     "ba": 7,
     "rbc": 8,
+    # rs.device spans nest inside the rbc.flush span: the device column must
+    # win that overlap so host-vs-device RS time splits cleanly
+    "rbc_device": 1.5,
 }
 
 # Python span name -> phase. Parent/orchestrator spans (era, HoneyBadger,
@@ -483,6 +487,8 @@ _PHASE_PRIORITY = {
 _SPAN_PHASE = {
     "consensus.propose": "propose",
     "ReliableBroadcast": "rbc",
+    "rbc.flush": "rbc",
+    "rs.device": "rbc_device",
     "BinaryAgreement": "ba",
     "BinaryBroadcast": "ba",
     "CommonCoin": "coin",
@@ -500,6 +506,8 @@ _CROSS_PHASE = {
     "hb_acs": "tpke_verify",
     "hb_queue": "tpke_decrypt",
     "hb_done": "tpke_decrypt",
+    "rbc_encode": "rbc",
+    "rbc_need": "rbc",
     "root_input": "propose",
     "root_sign": "commit",
     "root_verify": "commit",
